@@ -1,0 +1,10 @@
+"""handyrl_tpu — a TPU-native distributed self-play RL framework.
+
+Capability peer of DeNA/HandyRL (IMPALA-style learner/worker self-play with
+TD(lambda) / Monte-Carlo / V-Trace / UPGO off-policy corrections), rebuilt
+JAX-first: Flax models, a single jit/pjit-compiled update step over a device
+mesh, batched actor inference, and host-side Python only for environments and
+orchestration.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .tree import map_structure, stack_structure, batch_structure, unbatch_structure, softmax

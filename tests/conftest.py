@@ -1,0 +1,12 @@
+"""Test harness configuration.
+
+Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere, so
+all sharding/pjit code paths run the same program they would on a TPU slice.
+"""
+
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
