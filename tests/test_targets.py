@@ -1,0 +1,150 @@
+"""Golden tests for the target algorithms.
+
+Each scan implementation is checked against a slow, obviously-correct numpy
+loop oracle written directly from the recursions, plus hand-computed
+mini-sequences and structural properties (mask collapse, MC fallback).
+"""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.ops.targets import compute_target
+
+B, T, P = 3, 7, 2
+SHAPE = (B, T, P, 1)
+
+
+def _rand(seed):
+    rng = np.random.RandomState(seed)
+    values = rng.randn(*SHAPE).astype(np.float32)
+    returns = rng.randn(*SHAPE).astype(np.float32)
+    rewards = rng.randn(*SHAPE).astype(np.float32)
+    rhos = rng.uniform(0.1, 1.0, SHAPE).astype(np.float32)
+    cs = rng.uniform(0.1, 1.0, SHAPE).astype(np.float32)
+    masks = (rng.rand(*SHAPE) > 0.3).astype(np.float32)
+    return values, returns, rewards, rhos, cs, masks
+
+
+# ---- numpy loop oracles (independent re-derivation of the recursions) ----
+
+def np_lambda(lmb, masks):
+    return lmb + (1 - lmb) * (1 - masks)
+
+
+def np_td(values, returns, rewards, lambda_, gamma):
+    tv = np.zeros_like(values)
+    tv[:, -1] = returns[:, -1]
+    for t in range(T - 2, -1, -1):
+        r = rewards[:, t] if rewards is not None else 0
+        lam = lambda_[:, t + 1]
+        tv[:, t] = r + gamma * ((1 - lam) * values[:, t + 1] + lam * tv[:, t + 1])
+    return tv, tv - values
+
+
+def np_upgo(values, returns, rewards, lambda_, gamma):
+    tv = np.zeros_like(values)
+    tv[:, -1] = returns[:, -1]
+    for t in range(T - 2, -1, -1):
+        r = rewards[:, t] if rewards is not None else 0
+        lam = lambda_[:, t + 1]
+        mixed = (1 - lam) * values[:, t + 1] + lam * tv[:, t + 1]
+        tv[:, t] = r + gamma * np.maximum(values[:, t + 1], mixed)
+    return tv, tv - values
+
+
+def np_vtrace(values, returns, rewards, lambda_, gamma, rhos, cs):
+    rew = rewards if rewards is not None else np.zeros_like(values)
+    v_next = np.concatenate([values[:, 1:], returns[:, -1:]], axis=1)
+    deltas = rhos * (rew + gamma * v_next - values)
+    vmv = np.zeros_like(values)
+    vmv[:, -1] = deltas[:, -1]
+    for t in range(T - 2, -1, -1):
+        vmv[:, t] = deltas[:, t] + gamma * lambda_[:, t + 1] * cs[:, t] * vmv[:, t + 1]
+    vs = vmv + values
+    vs_next = np.concatenate([vs[:, 1:], returns[:, -1:]], axis=1)
+    adv = rew + gamma * vs_next - values
+    return vs, adv
+
+
+@pytest.mark.parametrize('algorithm', ['TD', 'UPGO', 'VTRACE'])
+@pytest.mark.parametrize('gamma', [1.0, 0.8])
+@pytest.mark.parametrize('use_rewards', [True, False])
+def test_targets_match_loop_oracle(algorithm, gamma, use_rewards):
+    values, returns, rewards, rhos, cs, masks = _rand(42)
+    rew = rewards if use_rewards else None
+    lmb = 0.7
+    got_t, got_a = compute_target(algorithm, values, returns, rew, lmb, gamma, rhos, cs, masks)
+
+    lambda_ = np_lambda(lmb, masks)
+    oracle = {'TD': np_td, 'UPGO': np_upgo}.get(algorithm)
+    if oracle is not None:
+        want_t, want_a = oracle(values, returns, rew, lambda_, gamma)
+    else:
+        want_t, want_a = np_vtrace(values, returns, rew, lambda_, gamma, rhos, cs)
+
+    np.testing.assert_allclose(np.asarray(got_t), want_t, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_a), want_a, rtol=1e-5, atol=1e-5)
+
+
+def test_monte_carlo():
+    values, returns, *_ , rhos, cs, masks = _rand(1)
+    t, a = compute_target('MC', values, returns, None, 0.7, 1.0, rhos, cs, masks)
+    np.testing.assert_allclose(np.asarray(t), returns)
+    np.testing.assert_allclose(np.asarray(a), returns - values, rtol=1e-6)
+
+
+def test_no_baseline_falls_back_to_returns():
+    _, returns, _, rhos, cs, masks = _rand(2)
+    t, a = compute_target('TD', None, returns, None, 0.7, 1.0, rhos, cs, masks)
+    np.testing.assert_allclose(np.asarray(t), returns)
+    np.testing.assert_allclose(np.asarray(a), returns)
+
+
+def test_td_hand_computed_two_steps():
+    """Tiny hand-derived case: B=1, T=2, P=1, full mask.
+    tv_1 = G_1; tv_0 = r_0 + g*((1-l)*V_1 + l*tv_1)."""
+    values = np.array([0.5, 0.25], np.float32).reshape(1, 2, 1, 1)
+    returns = np.array([0.9, 1.0], np.float32).reshape(1, 2, 1, 1)
+    rewards = np.array([0.1, 0.0], np.float32).reshape(1, 2, 1, 1)
+    ones = np.ones((1, 2, 1, 1), np.float32)
+    g, l = 0.9, 0.7
+    t, _ = compute_target('TD', values, returns, rewards, l, g, ones, ones, ones)
+    tv1 = 1.0
+    tv0 = 0.1 + g * ((1 - l) * 0.25 + l * tv1)
+    np.testing.assert_allclose(np.asarray(t).ravel(), [tv0, tv1], rtol=1e-6)
+
+
+def test_masked_steps_collapse_to_lambda_one():
+    """With mask=0 everywhere, lambda=1: TD target becomes the discounted
+    reward-sum bootstrapped from the final return (pure MC-style rollup)."""
+    values, returns, rewards, rhos, cs, _ = _rand(3)
+    zeros = np.zeros(SHAPE, np.float32)
+    g = 0.8
+    t, _ = compute_target('TD', values, returns, rewards, 0.3, g, rhos, cs, zeros)
+    want = np.zeros_like(values)
+    want[:, -1] = returns[:, -1]
+    for i in range(T - 2, -1, -1):
+        want[:, i] = rewards[:, i] + g * want[:, i + 1]
+    np.testing.assert_allclose(np.asarray(t), want, rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_hand_computed_two_steps():
+    """Fully hand-derived V-Trace case (B=1, T=2, P=1, full mask):
+    d0 = rho0*(r0 + g*v1 - v0), d1 = rho1*(r1 + g*G - v1),
+    vs = v + [d0 + g*l*c0*d1, d1], adv = r + g*[vs1, G] - v."""
+    def arr(*vals):
+        return np.array(vals, np.float32).reshape(1, 2, 1, 1)
+
+    values, returns = arr(0.5, 0.25), arr(0.0, 1.0)
+    rewards, rhos, cs = arr(0.1, 0.2), arr(0.8, 0.9), arr(0.7, 0.6)
+    ones = np.ones((1, 2, 1, 1), np.float32)
+    g, l = 0.9, 0.6
+    vs, adv = compute_target('VTRACE', values, returns, rewards, l, g, rhos, cs, ones)
+
+    d0 = 0.8 * (0.1 + g * 0.25 - 0.5)            # -0.14
+    d1 = 0.9 * (0.2 + g * 1.0 - 0.25)            # 0.765
+    vmv0 = d0 + g * l * 0.7 * d1                 # 0.14917
+    want_vs = [0.5 + vmv0, 0.25 + d1]
+    want_adv = [0.1 + g * want_vs[1] - 0.5, 0.2 + g * 1.0 - 0.25]
+    np.testing.assert_allclose(np.asarray(vs).ravel(), want_vs, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(adv).ravel(), want_adv, rtol=1e-5)
