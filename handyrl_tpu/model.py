@@ -1,0 +1,126 @@
+"""Model wrapper: Flax module + params with a numpy inference edge.
+
+TPU-native counterpart of the reference ModelWrapper (model.py:33-74). A
+"model" here is the pair (architecture, params pytree); the wrapper owns a
+jit-compiled apply and presents the same numpy-in/numpy-out single-sample
+``inference`` the generators/agents expect, plus a batched path used by the
+vectorized actors. Params travel over the wire as msgpack bytes + the
+architecture name — never as pickled code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from . import models as model_zoo
+from .utils.tree import map_structure
+
+
+def _to_numpy(x):
+    return jax.tree_util.tree_map(np.asarray, x)
+
+
+class ModelWrapper:
+    """Holds (module, params); provides jitted single/batched inference."""
+
+    def __init__(self, module, params=None, seed: int = 0):
+        self.module = module
+        self.params = params
+        self.seed = seed
+
+        @jax.jit
+        def _apply(params, obs, hidden):
+            return self.module.apply(params, obs, hidden)
+
+        self._apply = _apply
+
+    # -- params lifecycle -------------------------------------------------
+    def ensure_params(self, example_obs) -> None:
+        """Initialize params from an example observation if not set."""
+        if self.params is None:
+            obs = map_structure(lambda v: jnp.asarray(v)[None], example_obs)
+            hidden = self.init_hidden((1,))
+            self.params = self.module.init(jax.random.PRNGKey(self.seed), obs, hidden)
+
+    # -- hidden state -----------------------------------------------------
+    def init_hidden(self, batch_shape=None):
+        """None => single-sample numpy state (for host actors); otherwise a
+        device pytree with the given leading batch shape."""
+        if not hasattr(self.module, 'init_hidden'):
+            return None
+        if batch_shape is None:
+            return _to_numpy(self.module.init_hidden(()))
+        return self.module.init_hidden(tuple(batch_shape))
+
+    # -- inference --------------------------------------------------------
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        """Single sample: numpy in, numpy out, batch dim handled here."""
+        self.ensure_params(obs)
+        obs_b = map_structure(lambda v: None if v is None else jnp.asarray(v)[None], obs)
+        hidden_b = None
+        if hidden is not None:
+            hidden_b = jax.tree_util.tree_map(lambda v: jnp.asarray(v)[None], hidden)
+        outputs = self._apply(self.params, obs_b, hidden_b)
+        out = {}
+        for k, v in outputs.items():
+            if v is None:
+                continue
+            if k == 'hidden':
+                out[k] = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], v)
+            else:
+                out[k] = np.asarray(v)[0]
+        return out
+
+    def batch_inference(self, obs, hidden=None) -> Dict[str, Any]:
+        """Batched actor path: leading batch dim already present."""
+        self.ensure_params(map_structure(lambda v: v[0], obs))
+        outputs = self._apply(self.params, jax.tree_util.tree_map(jnp.asarray, obs), hidden)
+        return {k: v for k, v in outputs.items() if v is not None}
+
+    # -- wire format ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Architecture name + raw param bytes (safe to ship cross-process)."""
+        assert self.params is not None, 'snapshot of uninitialized model'
+        return {
+            'architecture': model_zoo.architecture_name(self.module),
+            'params': serialization.to_bytes(self.params),
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any], example_obs) -> 'ModelWrapper':
+        module = model_zoo.build(snap['architecture'])
+        wrapper = cls(module)
+        wrapper.ensure_params(example_obs)
+        wrapper.params = serialization.from_bytes(wrapper.params, snap['params'])
+        return wrapper
+
+    def load_params_bytes(self, raw: bytes, example_obs) -> None:
+        self.ensure_params(example_obs)
+        self.params = serialization.from_bytes(self.params, raw)
+
+    def params_bytes(self) -> bytes:
+        assert self.params is not None
+        return serialization.to_bytes(self.params)
+
+
+class RandomModel:
+    """Non-parametric stand-in: replays zero outputs shaped like a probe
+    inference, which after legal-action masking yields uniform random play
+    (reference model.py:65-74)."""
+
+    def __init__(self, wrapper: ModelWrapper, example_obs):
+        probe = wrapper.inference(example_obs, wrapper.init_hidden())
+        self.output_dict = {k: np.zeros_like(v) for k, v in probe.items()
+                            if k != 'hidden'}
+
+    def init_hidden(self, batch_shape=None):
+        return None
+
+    def inference(self, *args, **kwargs):
+        return self.output_dict

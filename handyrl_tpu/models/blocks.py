@@ -1,0 +1,149 @@
+"""Shared Flax building blocks.
+
+Model convention (framework-wide):
+  * ``module(obs, hidden)`` returns a dict with 'policy' (logits over the
+    action space), optionally 'value' / 'return' (shape (..., 1)), and
+    'hidden' (next recurrent state pytree) for RNNs.
+  * observations arrive channel-first (C, H, W) exactly as environments emit
+    them (parity with the reference protocol); blocks transpose to NHWC at
+    the input edge because that is the layout XLA tiles best onto the MXU.
+  * normalization is GroupNorm, not BatchNorm: stateless, no running-stats
+    collections to thread through lax.scan or checkpoints, and no cross-chip
+    batch-stat sync — the TPU-idiomatic choice for small conv nets.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
+    """(..., C, H, W) -> (..., H, W, C)."""
+    return jnp.moveaxis(x, -3, -1)
+
+
+class ConvBlock(nn.Module):
+    """3x3 conv + optional GroupNorm, operating on NHWC."""
+    filters: int
+    kernel: int = 3
+    norm: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='SAME',
+                    use_bias=not self.norm, dtype=self.dtype)(x)
+        if self.norm:
+            x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=self.dtype)(x)
+        return x
+
+
+class TorusConv(nn.Module):
+    """Conv with wrap-around (toroidal) padding, NHWC.
+
+    TPU-native counterpart of the reference's TorusConv2d
+    (hungry_geese.py:23-35): the wrap is a jnp.pad(mode='wrap') that XLA
+    fuses with the convolution."""
+    filters: int
+    kernel: int = 3
+    norm: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel // 2, self.kernel // 2
+        pad = [(0, 0)] * (x.ndim - 3) + [(kh, kh), (kw, kw), (0, 0)]
+        x = jnp.pad(x, pad, mode='wrap')
+        x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='VALID',
+                    use_bias=not self.norm, dtype=self.dtype)(x)
+        if self.norm:
+            x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=self.dtype)(x)
+        return x
+
+
+class PolicyHead(nn.Module):
+    """1x1 conv squeeze -> leaky-relu -> dense logits (no bias)."""
+    out_filters: int
+    outputs: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype)(x)
+        h = nn.leaky_relu(h, negative_slope=0.1)
+        h = h.reshape(*h.shape[:-3], -1)
+        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)
+
+
+class ScalarHead(nn.Module):
+    """1x1 conv + norm + relu -> dense scalar(s) (no bias)."""
+    filters: int
+    outputs: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        h = nn.GroupNorm(num_groups=1, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = h.reshape(*h.shape[:-3], -1)
+        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)
+
+
+class ConvLSTMCell(nn.Module):
+    """Convolutional LSTM cell on NHWC feature maps.
+
+    State is an (h, c) tuple with shape (..., H, W, F). Gates come from one
+    fused convolution over [x, h] — a single large MXU matmul per step.
+    """
+    features: int
+    kernel: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, state):
+        h_prev, c_prev = state
+        gates = nn.Conv(4 * self.features, (self.kernel, self.kernel),
+                        padding='SAME', dtype=self.dtype)(
+            jnp.concatenate([x, h_prev], axis=-1))
+        i, f, o, g = jnp.split(gates, 4, axis=-1)
+        c = nn.sigmoid(f) * c_prev + nn.sigmoid(i) * jnp.tanh(g)
+        h = nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+
+class DRC(nn.Module):
+    """Deep Repeated ConvLSTM (Guez et al. 2019, arXiv:1901.03559).
+
+    ``num_layers`` stacked ConvLSTM cells applied ``num_repeats`` times per
+    observation; layer i>0 consumes layer i-1's fresh hidden state. Hidden
+    state: tuple(list_h, list_c) with NHWC leaves.
+    """
+    num_layers: int = 3
+    features: int = 32
+    kernel: int = 3
+    num_repeats: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    def initial_state(self, spatial: Sequence[int], batch_shape=()):
+        shape = tuple(batch_shape) + tuple(spatial) + (self.features,)
+        zeros = jnp.zeros(shape, self.dtype)
+        hs = [zeros for _ in range(self.num_layers)]
+        cs = [zeros for _ in range(self.num_layers)]
+        return (hs, cs)
+
+    @nn.compact
+    def __call__(self, x, state):
+        if state is None:
+            state = self.initial_state(x.shape[-3:-1], x.shape[:-3])
+        cells = [ConvLSTMCell(self.features, self.kernel, dtype=self.dtype)
+                 for _ in range(self.num_layers)]
+        hs, cs = list(state[0]), list(state[1])
+        for _ in range(self.num_repeats):
+            for i, cell in enumerate(cells):
+                inp = x if i == 0 else hs[i - 1]
+                _, (hs[i], cs[i]) = cell(inp, (hs[i], cs[i]))
+        return hs[-1], (hs, cs)

@@ -1,0 +1,38 @@
+"""Hungry Geese net.
+
+Capability peer of the reference GeeseNet (hungry_geese.py:38-57): 12
+residual torus-conv blocks over the 17x7x11 board encoding; policy read out
+at the acting goose's head cell, value from head + global average pooling.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from .blocks import TorusConv, to_nhwc
+
+
+@register('GeeseNet')
+class GeeseNet(nn.Module):
+    filters: int = 32
+    layers: int = 12
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        x = to_nhwc(obs)                       # (..., 7, 11, 17)
+        h = nn.relu(TorusConv(self.filters, dtype=self.dtype)(x))
+        for _ in range(self.layers):
+            h = nn.relu(h + TorusConv(self.filters, dtype=self.dtype)(h))
+
+        # pool features at the acting goose's head cell (channel 0 of obs)
+        head_mask = x[..., :1]                 # (..., 7, 11, 1)
+        h_head = (h * head_mask).sum(axis=(-3, -2))   # (..., F)
+        h_avg = h.mean(axis=(-3, -2))                 # (..., F)
+
+        policy = nn.Dense(4, use_bias=False, dtype=self.dtype)(h_head)
+        value = jnp.tanh(nn.Dense(1, use_bias=False, dtype=self.dtype)(
+            jnp.concatenate([h_head, h_avg], axis=-1)))
+        return {'policy': policy, 'value': value}

@@ -1,0 +1,55 @@
+"""Geister net: recurrent DRC (Deep Repeated ConvLSTM) policy/value/return.
+
+Capability peer of the reference GeisterNet (geister.py:131-167): scalar
+features broadcast onto the 6x6 board, conv stem, 3-layer x 3-repeat DRC
+body, move policy (4x36) + setup policy (70) heads, tanh value head and a
+separate return head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from .blocks import ConvBlock, DRC, PolicyHead, ScalarHead, to_nhwc
+
+
+@register('GeisterNet')
+class GeisterNet(nn.Module):
+    filters: int = 32
+    drc_layers: int = 3
+    drc_repeats: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    def init_hidden(self, batch_shape=()):
+        """Zero DRC state: (hs, cs) lists of (..., 6, 6, F) arrays."""
+        shape = tuple(batch_shape) + (6, 6, self.filters)
+        zeros = jnp.zeros(shape, self.dtype)
+        return ([zeros] * self.drc_layers, [zeros] * self.drc_layers)
+
+    @nn.compact
+    def __call__(self, obs, hidden):
+        board = to_nhwc(obs['board'])                    # (..., 6, 6, 7)
+        scalar = obs['scalar']                           # (..., 18)
+        s_map = jnp.broadcast_to(scalar[..., None, None, :],
+                                 board.shape[:-1] + scalar.shape[-1:])
+        x = jnp.concatenate([board, s_map], axis=-1)     # (..., 6, 6, 25)
+
+        h = nn.relu(ConvBlock(self.filters, dtype=self.dtype)(x))
+        body = DRC(self.drc_layers, self.filters,
+                   num_repeats=self.drc_repeats, dtype=self.dtype)
+        if hidden is None:
+            hidden = self.init_hidden(h.shape[:-3])
+        h, next_hidden = body(h, hidden)
+
+        p_move = PolicyHead(8, 4 * 36, dtype=self.dtype)(h)
+        # setup-phase logits conditioned only on the side-to-move bit
+        turn_color = scalar[..., :1]
+        p_set = nn.Dense(70, dtype=self.dtype)(turn_color)
+        policy = jnp.concatenate([p_move, p_set], axis=-1)
+
+        value = jnp.tanh(ScalarHead(2, 1, dtype=self.dtype)(h))
+        ret = ScalarHead(2, 1, dtype=self.dtype)(h)
+        return {'policy': policy, 'value': value, 'return': ret,
+                'hidden': next_hidden}

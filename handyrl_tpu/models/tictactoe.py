@@ -1,0 +1,30 @@
+"""TicTacToe policy/value net.
+
+Capability peer of the reference SimpleConv2dModel (tictactoe.py:52-69):
+stem conv + 3 normalized conv blocks, 9-way policy head, tanh value head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from .blocks import ConvBlock, PolicyHead, ScalarHead, to_nhwc
+
+
+@register('SimpleConv2dModel')
+class SimpleConv2dModel(nn.Module):
+    filters: int = 32
+    layers: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        x = to_nhwc(obs)
+        h = nn.relu(nn.Conv(self.filters, (3, 3), padding='SAME', dtype=self.dtype)(x))
+        for _ in range(self.layers):
+            h = nn.relu(ConvBlock(self.filters, dtype=self.dtype)(h))
+        policy = PolicyHead(2, 9, dtype=self.dtype)(h)
+        value = jnp.tanh(ScalarHead(1, 1, dtype=self.dtype)(h))
+        return {'policy': policy, 'value': value}

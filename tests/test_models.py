@@ -1,0 +1,78 @@
+"""Model zoo tests: output shapes, hidden-state carry, snapshot round-trip."""
+
+import numpy as np
+
+from handyrl_tpu.model import ModelWrapper, RandomModel
+from handyrl_tpu.models import build
+from handyrl_tpu.envs.tictactoe import Environment as TicTacToe
+
+
+def test_simple_conv2d_shapes():
+    env = TicTacToe()
+    wrapper = ModelWrapper(env.net())
+    obs = env.observation(0)
+    out = wrapper.inference(obs)
+    assert out['policy'].shape == (9,)
+    assert out['value'].shape == (1,)
+    assert -1.0 <= float(out['value'][0]) <= 1.0
+    assert 'hidden' not in out
+
+
+def test_batch_inference_matches_single():
+    env = TicTacToe()
+    wrapper = ModelWrapper(env.net())
+    obs = env.observation(0)
+    single = wrapper.inference(obs)
+    batched = wrapper.batch_inference(np.stack([obs, obs]))
+    # B=1 and B=2 are different XLA programs; allow cross-compile numeric drift
+    np.testing.assert_allclose(np.asarray(batched['policy'])[0], single['policy'], atol=1e-2)
+    np.testing.assert_allclose(np.asarray(batched['policy'])[0],
+                               np.asarray(batched['policy'])[1], atol=1e-6)
+
+
+def test_geister_net_hidden_carry():
+    net = build('GeisterNet')
+    wrapper = ModelWrapper(net)
+    rng = np.random.RandomState(0)
+    obs = {'scalar': rng.rand(18).astype(np.float32),
+           'board': rng.rand(7, 6, 6).astype(np.float32)}
+    hidden = wrapper.init_hidden()
+    out = wrapper.inference(obs, hidden)
+    assert out['policy'].shape == (4 * 36 + 70,)
+    assert out['value'].shape == (1,)
+    assert out['return'].shape == (1,)
+    hs, cs = out['hidden']
+    assert len(hs) == 3 and hs[0].shape == (6, 6, 32)
+    # state must evolve under repeated observation
+    out2 = wrapper.inference(obs, out['hidden'])
+    assert not np.allclose(hs[0], out2['hidden'][0][0])
+
+
+def test_geese_net_shapes():
+    net = build('GeeseNet')
+    wrapper = ModelWrapper(net)
+    obs = np.zeros((17, 7, 11), np.float32)
+    obs[0, 3, 5] = 1.0  # own head
+    out = wrapper.inference(obs)
+    assert out['policy'].shape == (4,)
+    assert out['value'].shape == (1,)
+
+
+def test_snapshot_roundtrip():
+    env = TicTacToe()
+    obs = env.observation(0)
+    w1 = ModelWrapper(env.net(), seed=7)
+    p1 = w1.inference(obs)['policy']
+    snap = w1.snapshot()
+    assert snap['architecture'] == 'SimpleConv2dModel'
+    w2 = ModelWrapper.from_snapshot(snap, obs)
+    np.testing.assert_allclose(w2.inference(obs)['policy'], p1, atol=1e-6)
+
+
+def test_random_model_zero_outputs():
+    env = TicTacToe()
+    wrapper = ModelWrapper(env.net())
+    rm = RandomModel(wrapper, env.observation(0))
+    out = rm.inference()
+    assert np.all(out['policy'] == 0) and out['policy'].shape == (9,)
+    assert np.all(out['value'] == 0)
