@@ -1,0 +1,174 @@
+"""Episode storage and training-batch construction (host side).
+
+Turns ragged self-play episodes into the fixed-shape ``(B, T, P, ...)``
+arrays the compiled update step consumes. Semantics replicate the reference
+batch builder exactly (train.py:33-124) — every downstream mask depends on
+them:
+
+  * missing per-player entries are backfilled: prob -> 1, action -> 0,
+    action_mask -> +1e32 (all actions illegal), observation -> zeros;
+  * windows shorter than ``burn_in_steps + forward_steps`` are padded:
+    before-window with zeros (masks 0), after episode end with zeros except
+    ``value``, which is padded with the final outcome (terminal bootstrap),
+    and ``progress``, padded with 1;
+  * ``turn_mask`` marks steps where the player actually acted,
+    ``observation_mask`` steps where they observed, ``episode_mask`` real
+    (non-padding) steps.
+
+Episodes are stored as independently decompressible chunks of
+``compress_steps`` moments (bz2), so window selection only decodes the
+blocks it needs (generation.py:87-90, train.py:307-314).
+"""
+
+from __future__ import annotations
+
+import bz2
+import pickle
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.tree import map_structure, stack_structure
+
+MOMENT_KEYS = ('observation', 'selected_prob', 'action_mask', 'action',
+               'value', 'reward', 'return')
+
+
+def compress_moments(moments: List[dict], compress_steps: int) -> List[bytes]:
+    """Chunk + compress a finished episode's moments."""
+    return [bz2.compress(pickle.dumps(moments[i:i + compress_steps]))
+            for i in range(0, len(moments), compress_steps)]
+
+
+def decompress_moments(blocks: Sequence[bytes]) -> List[dict]:
+    out: List[dict] = []
+    for block in blocks:
+        out += pickle.loads(bz2.decompress(block))
+    return out
+
+
+def select_episode(episodes: Sequence[dict], args: Dict[str, Any]) -> dict:
+    """Recency-biased episode + window sampling (train.py:291-315).
+
+    Index i among N buffered episodes is accepted with probability
+    (i+1)/N — newer episodes are proportionally more likely — then a uniform
+    random ``forward_steps`` window (plus up to ``burn_in_steps`` of warmup
+    context) is sliced out, keeping only the compressed blocks it covers.
+    """
+    while True:
+        ep_count = min(len(episodes), args['maximum_episodes'])
+        ep_idx = random.randrange(ep_count)
+        accept_rate = 1 - (ep_count - 1 - ep_idx) / ep_count
+        if random.random() >= accept_rate:
+            continue
+        try:
+            ep = episodes[ep_idx]
+            break
+        except IndexError:
+            continue
+
+    turn_candidates = 1 + max(0, ep['steps'] - args['forward_steps'])
+    train_st = random.randrange(turn_candidates)
+    st = max(0, train_st - args['burn_in_steps'])
+    ed = min(train_st + args['forward_steps'], ep['steps'])
+    cs = args['compress_steps']
+    st_block, ed_block = st // cs, (ed - 1) // cs + 1
+    return {
+        'args': ep['args'], 'outcome': ep['outcome'],
+        'moment': ep['moment'][st_block:ed_block],
+        'base': st_block * cs,
+        'start': st, 'end': ed, 'train_start': train_st, 'total': ep['steps'],
+    }
+
+
+def _replace_none(value, fallback):
+    return value if value is not None else fallback
+
+
+def _build_one(ep: dict, args: Dict[str, Any]) -> Dict[str, Any]:
+    moments = decompress_moments(ep['moment'])[ep['start'] - ep['base']:ep['end'] - ep['base']]
+    players = list(moments[0]['observation'].keys())
+    if not args['turn_based_training']:   # solo training: one random seat
+        players = [random.choice(players)]
+
+    first_turn = moments[0]['turn'][0]
+    obs_zeros = map_structure(np.zeros_like, moments[0]['observation'][first_turn])
+    amask_full = np.zeros_like(moments[0]['action_mask'][first_turn]) + 1e32
+
+    if args['turn_based_training'] and not args['observation']:
+        # store only the turn player's data each step (P axis of size 1)
+        players_list = [[m['turn'][0]] for m in moments]
+    else:
+        players_list = [players for _ in moments]
+
+    obs = [[_replace_none(m['observation'][p], obs_zeros) for p in ps]
+           for m, ps in zip(moments, players_list)]
+    obs = stack_structure([stack_structure(row) for row in obs])   # (T, P, ...)
+
+    prob = np.array([[[_replace_none(m['selected_prob'][p], 1.0)] for p in ps]
+                     for m, ps in zip(moments, players_list)], dtype=np.float32)
+    act = np.array([[[_replace_none(m['action'][p], 0)] for p in ps]
+                    for m, ps in zip(moments, players_list)], dtype=np.int32)
+    amask = np.array([[_replace_none(m['action_mask'][p], amask_full) for p in ps]
+                      for m, ps in zip(moments, players_list)], dtype=np.float32)
+
+    T, P = len(moments), len(players)
+    v = np.array([[_replace_none(m['value'][p], [0]) for p in players]
+                  for m in moments], dtype=np.float32).reshape(T, P, -1)
+    rew = np.array([[_replace_none(m['reward'][p], 0) for p in players]
+                    for m in moments], dtype=np.float32).reshape(T, P, -1)
+    ret = np.array([[_replace_none(m['return'][p], 0) for p in players]
+                    for m in moments], dtype=np.float32).reshape(T, P, -1)
+    oc = np.array([ep['outcome'][p] for p in players],
+                  dtype=np.float32).reshape(1, P, -1)
+
+    # NOTE: masks span ALL players even in turn-alternating mode (where
+    # obs/prob/action/action_mask carry only the turn player, P=1): the
+    # loss pipeline gathers the turn player's policy row via turn_mask and
+    # gates per-player RNN state via observation_mask (train.py:86-87).
+    emask = np.ones((T, 1, 1), dtype=np.float32)
+    tmask = np.array([[[m['selected_prob'][p] is not None] for p in players]
+                      for m in moments], dtype=np.float32)
+    omask = np.array([[[m['observation'][p] is not None] for p in players]
+                      for m in moments], dtype=np.float32)
+    progress = (np.arange(ep['start'], ep['end'], dtype=np.float32)[:, None]
+                / ep['total'])
+
+    batch_steps = args['burn_in_steps'] + args['forward_steps']
+    if T < batch_steps:
+        pad_b = args['burn_in_steps'] - (ep['train_start'] - ep['start'])
+        pad_a = batch_steps - T - pad_b
+
+        def pad_t(a, before, after, value):
+            width = [(before, after)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, width, 'constant', constant_values=value)
+
+        obs = map_structure(lambda o: pad_t(o, pad_b, pad_a, 0), obs)
+        prob = pad_t(prob, pad_b, pad_a, 1)
+        # value: zeros before the window, final outcome beyond episode end
+        v = np.concatenate([pad_t(v, pad_b, 0, 0), np.tile(oc, (pad_a, 1, 1))])
+        act = pad_t(act, pad_b, pad_a, 0)
+        rew = pad_t(rew, pad_b, pad_a, 0)
+        ret = pad_t(ret, pad_b, pad_a, 0)
+        emask = pad_t(emask, pad_b, pad_a, 0)
+        tmask = pad_t(tmask, pad_b, pad_a, 0)
+        omask = pad_t(omask, pad_b, pad_a, 0)
+        amask = pad_t(amask, pad_b, pad_a, 1e32)
+        progress = pad_t(progress, pad_b, pad_a, 1)
+
+    return {
+        'observation': obs, 'selected_prob': prob, 'value': v, 'action': act,
+        'outcome': oc, 'reward': rew, 'return': ret, 'episode_mask': emask,
+        'turn_mask': tmask, 'observation_mask': omask, 'action_mask': amask,
+        'progress': progress,
+    }
+
+
+def make_batch(episodes: Sequence[dict], args: Dict[str, Any]) -> Dict[str, Any]:
+    """Build a (B, T, P, ...) training batch from selected episode windows."""
+    rows = [_build_one(ep, args) for ep in episodes]
+    batch = {}
+    for key in rows[0]:
+        batch[key] = stack_structure([r[key] for r in rows])
+    return batch

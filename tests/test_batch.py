@@ -1,0 +1,167 @@
+"""Batch builder tests: shapes, masks, and pad semantics on hand-built
+episodes."""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.ops.batch import (compress_moments, decompress_moments,
+                                   make_batch, select_episode)
+
+GAMMA = 0.8
+
+
+def _turn_based_episode(steps=5, obs_shape=(3, 3, 3), n_actions=9):
+    """Synthetic 2-player turn-alternating episode: player t%2 acts at step t."""
+    moments = []
+    for t in range(steps):
+        turn = t % 2
+        m = {key: {0: None, 1: None} for key in
+             ('observation', 'selected_prob', 'action_mask', 'action',
+              'value', 'reward', 'return')}
+        m['observation'][turn] = np.full(obs_shape, t + 1, np.float32)
+        m['selected_prob'][turn] = 0.5
+        amask = np.full(n_actions, 1e32, np.float32)
+        amask[:3] = 0
+        m['action_mask'][turn] = amask
+        m['action'][turn] = t % 3
+        m['value'][turn] = np.array([0.1 * t], np.float32)
+        m['reward'] = {0: 0.0, 1: 0.0}
+        m['return'] = {0: 0.25, 1: -0.25}
+        m['turn'] = [turn]
+        moments.append(m)
+    return {
+        'args': {'player': [0, 1]}, 'steps': steps,
+        'outcome': {0: 1.0, 1: -1.0},
+        'moment': compress_moments(moments, compress_steps=2),
+    }
+
+
+def _args(forward_steps=4, burn_in=0, observation=False, turn_based=True):
+    return {
+        'turn_based_training': turn_based, 'observation': observation,
+        'forward_steps': forward_steps, 'burn_in_steps': burn_in,
+        'compress_steps': 2, 'maximum_episodes': 100,
+    }
+
+
+def _window(ep, start, end, train_start=None, cs=2):
+    st_block, ed_block = start // cs, (end - 1) // cs + 1
+    return {
+        'args': ep['args'], 'outcome': ep['outcome'],
+        'moment': ep['moment'][st_block:ed_block], 'base': st_block * cs,
+        'start': start, 'end': end,
+        'train_start': start if train_start is None else train_start,
+        'total': ep['steps'],
+    }
+
+
+def test_compress_roundtrip():
+    ep = _turn_based_episode(5)
+    moments = decompress_moments(ep['moment'])
+    assert len(moments) == 5
+    assert moments[3]['turn'] == [1]
+
+
+def test_turn_alternating_shapes_and_masks():
+    ep = _turn_based_episode(5)
+    batch = make_batch([_window(ep, 0, 4)], _args(forward_steps=4))
+    # turn-alternating: obs/prob/act/amask have P=1; masks/values have P=2
+    assert batch['observation'].shape == (1, 4, 1, 3, 3, 3)
+    assert batch['selected_prob'].shape == (1, 4, 1, 1)
+    assert batch['action'].shape == (1, 4, 1, 1)
+    assert batch['action_mask'].shape == (1, 4, 1, 9)
+    assert batch['value'].shape == (1, 4, 2, 1)
+    assert batch['turn_mask'].shape == (1, 4, 2, 1)
+    assert batch['observation_mask'].shape == (1, 4, 2, 1)
+    assert batch['outcome'].shape == (1, 1, 2, 1)
+    # step t: player t%2 acted, other didn't
+    want_t = np.array([[1, 0], [0, 1], [1, 0], [0, 1]], np.float32)
+    np.testing.assert_array_equal(batch['turn_mask'][0, :, :, 0], want_t)
+    np.testing.assert_array_equal(batch['observation_mask'][0, :, :, 0], want_t)
+    assert batch['episode_mask'].min() == 1.0
+
+
+def test_short_window_padding_semantics():
+    ep = _turn_based_episode(3)
+    batch = make_batch([_window(ep, 0, 3)], _args(forward_steps=6))
+    # 3 real steps + 3 pad steps after episode end
+    assert batch['observation'].shape == (1, 6, 1, 3, 3, 3)
+    assert np.all(batch['observation'][0, 3:] == 0)
+    np.testing.assert_array_equal(batch['selected_prob'][0, 3:], 1.0)
+    np.testing.assert_array_equal(batch['action_mask'][0, 3:], np.float32(1e32))
+    np.testing.assert_array_equal(batch['episode_mask'][0, 3:], 0.0)
+    np.testing.assert_array_equal(batch['turn_mask'][0, 3:], 0.0)
+    # value is padded with the final OUTCOME beyond episode end
+    np.testing.assert_array_equal(batch['value'][0, 3:, 0, 0], 1.0)
+    np.testing.assert_array_equal(batch['value'][0, 3:, 1, 0], -1.0)
+    np.testing.assert_array_equal(batch['progress'][0, 3:, 0], 1.0)
+
+
+def test_burn_in_front_padding():
+    ep = _turn_based_episode(5)
+    # train window starts at 2 with burn_in 2 -> context from step 0
+    w = _window(ep, 0, 5, train_start=2)
+    batch = make_batch([w], _args(forward_steps=3, burn_in=2))
+    assert batch['observation'].shape[1] == 5
+    assert batch['episode_mask'][0].sum() == 5  # no padding needed
+
+
+def test_burn_in_truncated_at_episode_start():
+    ep = _turn_based_episode(5)
+    # train_start=1 but only 1 step of burn-in context exists -> pad front by 1
+    w = _window(ep, 0, 4, train_start=1)
+    batch = make_batch([w], _args(forward_steps=3, burn_in=2))
+    assert batch['observation'].shape[1] == 5
+    np.testing.assert_array_equal(batch['episode_mask'][0, 0], 0.0)
+    np.testing.assert_array_equal(batch['selected_prob'][0, 0], 1.0)
+    np.testing.assert_array_equal(batch['episode_mask'][0, 1:], 1.0)
+
+
+def test_observation_mode_all_players():
+    ep = _turn_based_episode(4)
+    batch = make_batch([_window(ep, 0, 4)], _args(observation=True))
+    # with observation=True every player's row is kept: P=2 everywhere
+    assert batch['observation'].shape == (1, 4, 2, 3, 3, 3)
+    assert batch['selected_prob'].shape == (1, 4, 2, 1)
+    # non-acting player's missing action_mask is the all-illegal +1e32 row
+    np.testing.assert_array_equal(batch['action_mask'][0, 0, 1], np.float32(1e32))
+    # non-acting player's prob backfilled to 1 => log prob 0
+    assert batch['selected_prob'][0, 0, 1, 0] == 1.0
+
+
+def test_dict_observation_support():
+    steps = 3
+    moments = []
+    for t in range(steps):
+        m = {key: {0: None} for key in
+             ('observation', 'selected_prob', 'action_mask', 'action',
+              'value', 'reward', 'return')}
+        m['observation'][0] = {'scalar': np.ones(4, np.float32),
+                               'board': np.ones((2, 3, 3), np.float32)}
+        m['selected_prob'][0] = 1.0
+        m['action_mask'][0] = np.zeros(5, np.float32)
+        m['action'][0] = 0
+        m['value'][0] = [0.0]
+        m['reward'][0] = 0.0
+        m['return'][0] = 0.0
+        m['turn'] = [0]
+        moments.append(m)
+    ep = {'args': {'player': [0]}, 'steps': steps, 'outcome': {0: 0.0},
+          'moment': compress_moments(moments, 2)}
+    batch = make_batch([_window(ep, 0, 3)], _args(forward_steps=3))
+    assert batch['observation']['scalar'].shape == (1, 3, 1, 4)
+    assert batch['observation']['board'].shape == (1, 3, 1, 2, 3, 3)
+
+
+def test_select_episode_window_bounds():
+    import random
+    random.seed(0)
+    ep = _turn_based_episode(20)
+    args = _args(forward_steps=8, burn_in=2)
+    for _ in range(50):
+        w = select_episode([ep], args)
+        assert 0 <= w['start'] <= w['train_start'] < w['end'] <= 20
+        assert w['end'] - w['train_start'] <= 8
+        assert w['train_start'] - w['start'] <= 2
+        moments = decompress_moments(w['moment'])
+        assert len(moments) >= w['end'] - w['base'] - (w['start'] - w['base'])
