@@ -1,0 +1,115 @@
+"""Agents: policies driving a single environment in matches.
+
+Parity with the reference agent set (agent.py:13-113): RandomAgent,
+RuleBasedAgent, greedy/temperature Agent, EnsembleAgent (output averaging),
+SoftAgent (temperature 1).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .utils.tree import softmax
+
+
+class RandomAgent:
+    def reset(self, env, show=False):
+        pass
+
+    def action(self, env, player, show=False):
+        return random.choice(env.legal_actions(player))
+
+    def observe(self, env, player, show=False):
+        return [0.0]
+
+
+class RuleBasedAgent(RandomAgent):
+    """Defers to the env's ``rule_based_action`` when it has one."""
+
+    def __init__(self, key: Optional[str] = None):
+        self.key = key
+
+    def action(self, env, player, show=False):
+        if hasattr(env, 'rule_based_action'):
+            return env.rule_based_action(player, key=self.key)
+        return random.choice(env.legal_actions(player))
+
+
+def print_outputs(env, prob, v):
+    if hasattr(env, 'print_outputs'):
+        env.print_outputs(prob, v)
+    else:
+        if v is not None:
+            print('v = %f' % v)
+        if prob is not None:
+            print('p = %s' % (prob * 1000).astype(int))
+
+
+class Agent:
+    """Model-driven agent; temperature 0 = argmax over legal actions."""
+
+    def __init__(self, model, temperature: float = 0.0, observation: bool = True):
+        self.model = model
+        self.hidden = None
+        self.temperature = temperature
+        self.observation = observation
+
+    def reset(self, env, show=False):
+        self.hidden = self.model.init_hidden()
+
+    def plan(self, obs):
+        outputs = self.model.inference(obs, self.hidden)
+        self.hidden = outputs.pop('hidden', None)
+        return outputs
+
+    def action(self, env, player, show=False):
+        outputs = self.plan(env.observation(player))
+        actions = env.legal_actions(player)
+        p = outputs['policy']
+        v = outputs.get('value', None)
+        mask = np.ones_like(p)
+        mask[actions] = 0
+        p = p - mask * 1e32
+
+        if show:
+            print_outputs(env, softmax(p), v)
+
+        if self.temperature == 0:
+            return max(actions, key=lambda a: p[a])
+        probs = softmax(p / self.temperature)
+        return random.choices(np.arange(len(p)), weights=probs)[0]
+
+    def observe(self, env, player, show=False):
+        v = None
+        if self.observation:
+            outputs = self.plan(env.observation(player))
+            v = outputs.get('value', None)
+            if show:
+                print_outputs(env, None, v)
+        return v
+
+
+class EnsembleAgent(Agent):
+    """Averages the outputs of several models (each with its own hidden)."""
+
+    def reset(self, env, show=False):
+        self.hidden = [model.init_hidden() for model in self.model]
+
+    def plan(self, obs):
+        outputs: dict = {}
+        for i, model in enumerate(self.model):
+            out = model.inference(obs, self.hidden[i])
+            for k, v in out.items():
+                if k == 'hidden':
+                    self.hidden[i] = v
+                else:
+                    outputs.setdefault(k, []).append(v)
+        return {k: np.mean(v, axis=0) for k, v in outputs.items()}
+
+
+class SoftAgent(Agent):
+    def __init__(self, model):
+        super().__init__(model, temperature=1.0)

@@ -1,0 +1,207 @@
+"""Process/host communication substrate.
+
+Counterpart of the reference's connection layer (connection.py): 4-byte
+big-endian length-framed messages over TCP sockets plus mp.Pipe fan-out for
+same-host workers, thread-multiplexed into queues.
+
+Payloads are serialized with pickle — only ever our own episode/result dicts
+of numpy arrays between our own processes. Model parameters specifically are
+shipped as msgpack bytes + architecture name inside those dicts (see
+model.ModelWrapper.snapshot), never as pickled code objects, so a model
+snapshot cannot execute anything on load.
+"""
+
+from __future__ import annotations
+
+import io
+import multiprocessing as mp
+import multiprocessing.connection as mp_connection
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Callable, Iterator, List, Optional
+
+
+def send_recv(conn, data):
+    conn.send(data)
+    return conn.recv()
+
+
+class FramedConnection:
+    """Length-framed messages over a stream socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.conn: Optional[socket.socket] = sock
+
+    def __del__(self):
+        self.close()
+
+    def close(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+    def fileno(self) -> int:
+        return self.conn.fileno()
+
+    def _recv_exact(self, size: int) -> bytes:
+        buf = io.BytesIO()
+        while size > 0:
+            chunk = self.conn.recv(size)
+            if len(chunk) == 0:
+                raise ConnectionResetError
+            size -= len(chunk)
+            buf.write(chunk)
+        return buf.getvalue()
+
+    def recv(self):
+        (size,) = struct.unpack('!i', self._recv_exact(4))
+        return pickle.loads(self._recv_exact(size))
+
+    def send(self, msg):
+        payload = pickle.dumps(msg)
+        self.conn.sendall(struct.pack('!i', len(payload)) + payload)
+
+
+def open_socket_connection(port: int) -> socket.socket:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(('', int(port)))
+    return sock
+
+
+def connect_socket_connection(host: str, port: int) -> FramedConnection:
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.connect((host, int(port)))
+    except ConnectionRefusedError:
+        print('failed to connect %s %d' % (host, port))
+    return FramedConnection(sock)
+
+
+def accept_socket_connections(port: int, timeout: Optional[float] = None,
+                              maxsize: int = 1024
+                              ) -> Iterator[Optional[FramedConnection]]:
+    sock = open_socket_connection(port)
+    sock.listen(maxsize)
+    sock.settimeout(timeout)
+    count = 0
+    while count < maxsize:
+        try:
+            conn, _ = sock.accept()
+            count += 1
+            yield FramedConnection(conn)
+        except socket.timeout:
+            yield None
+
+
+def open_multiprocessing_connections(num_process: int, target: Callable,
+                                     args_func: Callable) -> List:
+    """Fork ``num_process`` workers, each holding one end of an mp.Pipe;
+    returns the parent-side ends."""
+    parent_conns = []
+    for i in range(num_process):
+        conn0, conn1 = mp.Pipe(duplex=True)
+        mp.Process(target=target, args=args_func(i, conn1), daemon=True).start()
+        conn1.close()
+        parent_conns.append(conn0)
+    return parent_conns
+
+
+class MultiProcessJobExecutor:
+    """Round-robin job fan-out over worker processes.
+
+    A sender thread feeds the next item from ``send_generator`` to any free
+    worker; a receiver thread multiplexes results into a bounded queue.
+    """
+
+    def __init__(self, func: Callable, send_generator, num_workers: int,
+                 postprocess: Optional[Callable] = None, out_maxsize: int = 8):
+        self.send_generator = send_generator
+        self.postprocess = postprocess
+        self.conns: List = []
+        self.waiting_conns: queue.Queue = queue.Queue()
+        self.output_queue: queue.Queue = queue.Queue(maxsize=out_maxsize)
+
+        for i in range(num_workers):
+            conn0, conn1 = mp.Pipe(duplex=True)
+            mp.Process(target=func, args=(conn1, i), daemon=True).start()
+            conn1.close()
+            self.conns.append(conn0)
+            self.waiting_conns.put(conn0)
+
+    def recv(self):
+        return self.output_queue.get()
+
+    def start(self):
+        threading.Thread(target=self._sender, daemon=True).start()
+        threading.Thread(target=self._receiver, daemon=True).start()
+
+    def _sender(self):
+        while True:
+            data = next(self.send_generator)
+            conn = self.waiting_conns.get()
+            conn.send(data)
+
+    def _receiver(self):
+        while True:
+            for conn in mp_connection.wait(self.conns):
+                data = conn.recv()
+                self.waiting_conns.put(conn)
+                if self.postprocess is not None:
+                    data = self.postprocess(data)
+                self.output_queue.put(data)
+
+
+class QueueCommunicator:
+    """Bidirectional multiplexer over a dynamic set of connections.
+
+    Dead connections (reset/EOF/broken pipe) are dropped silently — workers
+    are elastic by design; the server keys only on connection_count().
+    """
+
+    def __init__(self, conns: Optional[List] = None, maxsize: int = 256):
+        self.input_queue: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.output_queue: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.conns: set = set()
+        for conn in conns or []:
+            self.add_connection(conn)
+        threading.Thread(target=self._send_thread, daemon=True).start()
+        threading.Thread(target=self._recv_thread, daemon=True).start()
+
+    def connection_count(self) -> int:
+        return len(self.conns)
+
+    def recv(self, timeout: Optional[float] = None):
+        return self.input_queue.get(timeout=timeout)
+
+    def send(self, conn, data):
+        self.output_queue.put((conn, data))
+
+    def add_connection(self, conn):
+        self.conns.add(conn)
+
+    def disconnect(self, conn):
+        print('disconnected')
+        self.conns.discard(conn)
+
+    def _send_thread(self):
+        while True:
+            conn, data = self.output_queue.get()
+            try:
+                conn.send(data)
+            except (TimeoutError, ConnectionResetError, BrokenPipeError):
+                self.disconnect(conn)
+
+    def _recv_thread(self):
+        while True:
+            conns = mp_connection.wait(self.conns, timeout=0.3)
+            for conn in conns:
+                try:
+                    data = conn.recv()
+                except (TimeoutError, ConnectionResetError, EOFError, OSError):
+                    self.disconnect(conn)
+                    continue
+                self.input_queue.put((conn, data))

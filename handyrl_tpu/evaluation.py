@@ -1,0 +1,381 @@
+"""Evaluation: online evaluator role, offline match harness, network battles.
+
+Parity with the reference evaluation stack (evaluation.py): shared-env
+matches (``exec_match``), delta-synced per-player env matches
+(``exec_network_match``), the multiprocess tournament runner with
+first/second-player balancing, and the TCP network battle mode on port 9876
+(server accepts remote/human agents speaking the diff_info protocol).
+
+Model files are our msgpack checkpoints (see train.py) — loading one cannot
+execute code, unlike unpickling a torch module.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+from .agent import Agent, EnsembleAgent, RandomAgent, RuleBasedAgent, SoftAgent
+from .connection import (accept_socket_connections, connect_socket_connection,
+                         send_recv)
+from .environment import make_env, prepare_env
+
+network_match_port = 9876
+
+
+def view(env, player=None):
+    if hasattr(env, 'view'):
+        env.view(player=player)
+    else:
+        print(env)
+
+
+def view_transition(env):
+    if hasattr(env, 'view_transition'):
+        env.view_transition()
+
+
+class NetworkAgentClient:
+    """Client side of a network battle: executes commands from the server
+    against a local env + agent."""
+
+    def __init__(self, agent, env, conn):
+        self.conn = conn
+        self.agent = agent
+        self.env = env
+
+    def run(self):
+        while True:
+            try:
+                command, args = self.conn.recv()
+            except ConnectionResetError:
+                break
+            if command == 'quit':
+                break
+            elif command == 'outcome':
+                print('outcome = %f' % args[0])
+            elif hasattr(self.agent, command):
+                if command in ('action', 'observe'):
+                    view(self.env)
+                ret = getattr(self.agent, command)(self.env, *args, show=True)
+                if command == 'action':
+                    player = args[0]
+                    ret = self.env.action2str(ret, player)
+            else:
+                ret = getattr(self.env, command)(*args)
+                if command == 'update':
+                    reset = args[1]
+                    if reset:
+                        self.agent.reset(self.env, show=True)
+                    else:
+                        view_transition(self.env)
+            self.conn.send(ret)
+
+
+class NetworkAgent:
+    """Server-side stub driving a remote NetworkAgentClient."""
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    def update(self, data, reset):
+        return send_recv(self.conn, ('update', [data, reset]))
+
+    def outcome(self, outcome):
+        return send_recv(self.conn, ('outcome', [outcome]))
+
+    def action(self, player):
+        return send_recv(self.conn, ('action', [player]))
+
+    def observe(self, player):
+        return send_recv(self.conn, ('observe', [player]))
+
+
+def exec_match(env, agents: Dict[int, Any], critic=None, show=False,
+               game_args={}) -> Optional[dict]:
+    """Match on one shared environment."""
+    if env.reset(game_args):
+        return None
+    for agent in agents.values():
+        agent.reset(env, show=show)
+    while not env.terminal():
+        if show:
+            view(env)
+        if show and critic is not None:
+            print('cv = ', critic.observe(env, None, show=False)[0])
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in agents.items():
+            if p in turn_players:
+                actions[p] = agent.action(env, p, show=show)
+            elif p in observers:
+                agent.observe(env, p, show=show)
+        if env.step(actions):
+            return None
+        if show:
+            view_transition(env)
+    outcome = env.outcome()
+    if show:
+        print('final outcome = %s' % outcome)
+    return {'result': outcome}
+
+
+def exec_network_match(env, network_agents: Dict[int, NetworkAgent],
+                       critic=None, show=False, game_args={}) -> Optional[dict]:
+    """Match where each remote agent mirrors the env from diff_info deltas and
+    communicates actions as strings."""
+    if env.reset(game_args):
+        return None
+    for p, agent in network_agents.items():
+        agent.update(env.diff_info(p), True)
+    while not env.terminal():
+        if show:
+            view(env)
+        turn_players = env.turns()
+        observers = env.observers()
+        actions = {}
+        for p, agent in network_agents.items():
+            if p in turn_players:
+                actions[p] = env.str2action(agent.action(p), p)
+            elif p in observers:
+                agent.observe(p)
+        if env.step(actions):
+            return None
+        for p, agent in network_agents.items():
+            agent.update(env.diff_info(p), False)
+    outcome = env.outcome()
+    for p, agent in network_agents.items():
+        agent.outcome(outcome[p])
+    return {'result': outcome}
+
+
+def build_agent(raw: str, env=None):
+    if raw == 'random':
+        return RandomAgent()
+    if raw.startswith('rulebase'):
+        key = raw.split('-')[1] if '-' in raw else None
+        return RuleBasedAgent(key)
+    return None
+
+
+class Evaluator:
+    """Online evaluation during training: the trained model vs a configured
+    opponent pool (default 'random')."""
+
+    def __init__(self, env, args):
+        self.env = env
+        self.args = args
+        self.default_opponent = 'random'
+
+    def execute(self, models: Dict[int, Any], eval_args) -> Optional[dict]:
+        opponents = self.args.get('eval', {}).get('opponent', [])
+        opponent = random.choice(opponents) if opponents else self.default_opponent
+
+        agents = {}
+        for p, model in models.items():
+            if model is None:
+                agents[p] = build_agent(opponent, self.env)
+            else:
+                agents[p] = Agent(model)
+
+        results = exec_match(self.env, agents)
+        if results is None:
+            print('None episode in evaluation!')
+            return None
+        return {'args': eval_args, 'opponent': opponent, **results}
+
+
+def wp_func(results: Dict[Optional[float], int]) -> float:
+    games = sum(v for k, v in results.items() if k is not None)
+    win = sum((k + 1) / 2 * v for k, v in results.items() if k is not None)
+    return win / games if games else 0.0
+
+
+def eval_process_mp_child(agents, critic, env_args, index, in_queue, out_queue,
+                          seed, show=False):
+    random.seed(seed + index)
+    env = make_env({**env_args, 'id': index})
+    while True:
+        args = in_queue.get()
+        if args is None:
+            break
+        g, agent_ids, pat_idx, game_args = args
+        print('*** Game %d ***' % g)
+        agent_map = {env.players()[p]: agents[ai]
+                     for p, ai in enumerate(agent_ids)}
+        if isinstance(list(agent_map.values())[0], NetworkAgent):
+            results = exec_network_match(env, agent_map, critic, show=show,
+                                         game_args=game_args)
+        else:
+            results = exec_match(env, agent_map, critic, show=show,
+                                 game_args=game_args)
+        out_queue.put((pat_idx, agent_ids, results))
+    out_queue.put(None)
+
+
+def evaluate_mp(env, agents: List[Any], critic, env_args, args_patterns,
+                num_process: int, num_games: int, seed: int):
+    """Offline tournament: jobs over N processes; in 2-player games the
+    first/second seats are balanced across games."""
+    in_queue, out_queue = mp.Queue(), mp.Queue()
+    args_cnt = 0
+    total_results = [{} for _ in agents]
+    result_map = [{} for _ in agents]
+    print('total games = %d' % (len(args_patterns) * num_games))
+    time.sleep(0.1)
+    for pat_idx, args in args_patterns.items():
+        for i in range(num_games):
+            if len(agents) == 2:
+                first = 0 if i < (num_games + 1) // 2 else 1
+                tmp_pat_idx, agent_ids = ((pat_idx + '-F', [0, 1]) if first == 0
+                                          else (pat_idx + '-S', [1, 0]))
+            else:
+                tmp_pat_idx = pat_idx
+                agent_ids = random.sample(range(len(agents)), len(agents))
+            in_queue.put((args_cnt, agent_ids, tmp_pat_idx, args))
+            for p in range(len(agents)):
+                result_map[p][tmp_pat_idx] = {}
+            args_cnt += 1
+
+    network_mode = agents[0] is None
+    if network_mode:
+        agents = network_match_acception(num_process, env_args, len(agents),
+                                         network_match_port)
+    else:
+        agents = [agents] * num_process
+
+    for i in range(num_process):
+        in_queue.put(None)
+        args = agents[i], critic, env_args, i, in_queue, out_queue, seed
+        if num_process > 1:
+            mp.Process(target=eval_process_mp_child, args=args).start()
+            if network_mode:
+                for agent in agents[i]:
+                    agent.conn.close()
+        else:
+            eval_process_mp_child(*args, show=True)
+
+    finished_cnt = 0
+    while finished_cnt < num_process:
+        ret = out_queue.get()
+        if ret is None:
+            finished_cnt += 1
+            continue
+        pat_idx, agent_ids, results = ret
+        outcome = results.get('result') if results else None
+        if outcome is not None:
+            for idx, p in enumerate(env.players()):
+                agent_id = agent_ids[idx]
+                oc = outcome[p]
+                result_map[agent_id][pat_idx][oc] = \
+                    result_map[agent_id][pat_idx].get(oc, 0) + 1
+                total_results[agent_id][oc] = total_results[agent_id].get(oc, 0) + 1
+
+    for p, r_map in enumerate(result_map):
+        print('---agent %d---' % p)
+        for pat_idx, results in r_map.items():
+            print(pat_idx, {k: results[k] for k in sorted(results, reverse=True)},
+                  wp_func(results))
+        print('total', {k: total_results[p][k]
+                        for k in sorted(total_results[p], reverse=True)},
+              wp_func(total_results[p]))
+
+
+def network_match_acception(n: int, env_args, num_agents: int, port: int):
+    """Accept n*num_agents client connections; group into per-match agent
+    lists."""
+    waiting, accepted = [], []
+    for conn in accept_socket_connections(port):
+        if len(accepted) >= n * num_agents:
+            break
+        waiting.append(conn)
+        if len(waiting) == num_agents:
+            conn = waiting.pop(0)
+            accepted.append(conn)
+            conn.send(env_args)
+    return [[NetworkAgent(accepted[i * num_agents + j])
+             for j in range(num_agents)] for i in range(n)]
+
+
+def load_model(model_path: str, env):
+    """Load a checkpoint produced by the learner (msgpack params + arch)."""
+    from .model import ModelWrapper
+    wrapper = ModelWrapper(env.net())
+    env.reset()
+    example_obs = env.observation(env.players()[0])
+    with open(model_path, 'rb') as f:
+        wrapper.load_params_bytes(f.read(), example_obs)
+    return wrapper
+
+
+def _resolve_agent(model_path: str, env):
+    agent = build_agent(model_path, env)
+    if agent is None:
+        agent = Agent(load_model(model_path, env))
+    return agent
+
+
+def eval_main(args, argv):
+    env_args = args['env_args']
+    prepare_env(env_args)
+    env = make_env(env_args)
+
+    model_paths = argv[0].split(':') if len(argv) >= 1 else ['models/latest.ckpt']
+    num_games = int(argv[1]) if len(argv) >= 2 else 100
+    num_process = int(argv[2]) if len(argv) >= 3 else 1
+
+    main_agent = _resolve_agent(model_paths[0], env)
+    critic = None
+
+    print('%d process, %d games' % (num_process, num_games))
+    seed = random.randrange(int(1e8))
+    print('seed = %d' % seed)
+
+    opponent = model_paths[1] if len(model_paths) > 1 else 'random'
+    agents = [main_agent] + [_resolve_agent(opponent, env)
+                             for _ in range(len(env.players()) - 1)]
+    evaluate_mp(env, agents, critic, env_args, {'default': {}},
+                num_process, num_games, seed)
+
+
+def eval_server_main(args, argv):
+    print('network match server mode')
+    env_args = args['env_args']
+    prepare_env(env_args)
+    env = make_env(env_args)
+
+    num_games = int(argv[0]) if len(argv) >= 1 else 100
+    num_process = int(argv[1]) if len(argv) >= 2 else 1
+
+    print('%d process, %d games' % (num_process, num_games))
+    seed = random.randrange(int(1e8))
+    print('seed = %d' % seed)
+
+    evaluate_mp(env, [None] * len(env.players()), None, env_args,
+                {'default': {}}, num_process, num_games, seed)
+
+
+def client_mp_child(env_args, model_path, conn):
+    env = make_env(env_args)
+    agent = build_agent(model_path, env)
+    if agent is None:
+        agent = Agent(load_model(model_path, env))
+    NetworkAgentClient(agent, env, conn).run()
+
+
+def eval_client_main(args, argv):
+    print('network match client mode')
+    while True:
+        try:
+            host = argv[1] if len(argv) >= 2 else 'localhost'
+            conn = connect_socket_connection(host, network_match_port)
+            env_args = conn.recv()
+        except ConnectionResetError:
+            break
+        model_path = argv[0] if len(argv) >= 1 else 'models/latest.ckpt'
+        mp.Process(target=client_mp_child,
+                   args=(env_args, model_path, conn)).start()
+        conn.close()

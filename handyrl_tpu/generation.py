@@ -1,0 +1,229 @@
+"""Episode generation.
+
+Two engines produce identical episode records (the data contract of
+generation.py:20-93 in the reference):
+
+  * ``Generator`` — one env, one step at a time, per-player ``inference``
+    calls. Used by remote CPU workers and evaluation, and by games where
+    players run different models.
+
+  * ``BatchedGenerator`` — the TPU-first engine: N environments advance in
+    lockstep against ONE jitted batched forward pass per step (self-play,
+    shared latest model). The reference does B=1 CPU inference per env step
+    (model.py:50-60); batching across envs is where actor throughput comes
+    from. Finished episodes stream out; their slots reset immediately.
+
+Episode record: ``{'args', 'steps', 'outcome', 'moment': [bz2 chunks]}``
+with per-step moment dicts of 7 per-player entries + the turn list.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .ops.batch import MOMENT_KEYS, compress_moments
+from .utils.tree import map_structure, softmax, stack_structure
+
+
+def _sample_action(policy: np.ndarray, legal_actions) -> tuple:
+    """Mask illegal logits with +1e32 penalty, softmax, sample.
+
+    Returns (action, prob_of_action, action_mask)."""
+    action_mask = np.ones_like(policy) * 1e32
+    action_mask[legal_actions] = 0
+    p = softmax(policy - action_mask)
+    action = random.choices(legal_actions, weights=p[legal_actions])[0]
+    return action, p[action], action_mask
+
+
+def _blank_moment(players) -> Dict[str, Dict[int, Any]]:
+    return {key: {p: None for p in players} for key in MOMENT_KEYS}
+
+
+def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
+                      gen_args: Dict[str, Any]) -> Optional[dict]:
+    if len(moments) < 1:
+        return None
+    for player in env.players():
+        ret = 0.0
+        for i, m in reversed(list(enumerate(moments))):
+            ret = (m['reward'][player] or 0) + args['gamma'] * ret
+            moments[i]['return'][player] = ret
+    return {
+        'args': gen_args, 'steps': len(moments),
+        'outcome': env.outcome(),
+        'moment': compress_moments(moments, args['compress_steps']),
+    }
+
+
+class Generator:
+    """Sequential single-env episode generator (reference-parity engine)."""
+
+    def __init__(self, env, args: Dict[str, Any]):
+        self.env = env
+        self.args = args
+
+    def generate(self, models: Dict[int, Any], gen_args: Dict[str, Any]
+                 ) -> Optional[dict]:
+        moments: List[dict] = []
+        hidden = {p: models[p].init_hidden() for p in self.env.players()}
+        if self.env.reset():
+            return None
+
+        while not self.env.terminal():
+            moment = _blank_moment(self.env.players())
+            turn_players = self.env.turns()
+            observers = self.env.observers()
+
+            for player in self.env.players():
+                if player not in turn_players + observers:
+                    continue
+                if (player not in turn_players and player in gen_args['player']
+                        and not self.args['observation']):
+                    continue
+
+                obs = self.env.observation(player)
+                outputs = models[player].inference(obs, hidden[player])
+                hidden[player] = outputs.get('hidden', None)
+                moment['observation'][player] = obs
+                moment['value'][player] = outputs.get('value', None)
+
+                if player in turn_players:
+                    action, prob, amask = _sample_action(
+                        outputs['policy'], self.env.legal_actions(player))
+                    moment['selected_prob'][player] = prob
+                    moment['action_mask'][player] = amask
+                    moment['action'][player] = action
+
+            if self.env.step(moment['action']):
+                return None
+
+            reward = self.env.reward()
+            for player in self.env.players():
+                moment['reward'][player] = reward.get(player, None)
+            moment['turn'] = turn_players
+            moments.append(moment)
+
+        return _finalize_episode(self.env, moments, self.args, gen_args)
+
+    def execute(self, models, gen_args) -> Optional[dict]:
+        episode = self.generate(models, gen_args)
+        if episode is None:
+            print('None episode in generation!')
+        return episode
+
+
+class BatchedGenerator:
+    """N-env lockstep self-play generator against one batched forward.
+
+    Every step gathers the observations of all (env, player) pairs that must
+    run inference, evaluates them in ONE ``batch_inference`` call on device,
+    then samples/steps on host. Recurrent state lives host-side per
+    (env, player) and rides along in the same batch.
+    """
+
+    def __init__(self, make_env_fn, wrapper, args: Dict[str, Any],
+                 n_envs: int = 64):
+        self.envs = [make_env_fn(i) for i in range(n_envs)]
+        self.wrapper = wrapper
+        self.args = args
+        self.n_envs = n_envs
+        self._moments: List[List[dict]] = [[] for _ in range(n_envs)]
+        self._hidden: List[Dict[int, Any]] = [{} for _ in range(n_envs)]
+        for i, env in enumerate(self.envs):
+            env.reset()
+            self._hidden[i] = {p: wrapper.init_hidden() for p in env.players()}
+
+    def _gen_args(self, env) -> Dict[str, Any]:
+        return {'role': 'g', 'player': env.players(),
+                'model_id': {p: -1 for p in env.players()}}
+
+    def step(self) -> List[dict]:
+        """Advance all envs one step; returns episodes finished this step."""
+        jobs = []   # (env_idx, player, acting: bool, obs)
+        for i, env in enumerate(self.envs):
+            turn_players = env.turns()
+            observers = env.observers()
+            for player in env.players():
+                if player not in turn_players + observers:
+                    continue
+                if (player not in turn_players and not self.args['observation']):
+                    continue
+                jobs.append((i, player, player in turn_players,
+                             env.observation(player)))
+
+        if not jobs:
+            return []
+
+        # pad the row count to a power-of-two bucket so simultaneous games
+        # (variable active-player counts) trigger at most log2 recompiles
+        rows = len(jobs)
+        bucket = max(8, 1 << (rows - 1).bit_length())
+        pad = bucket - rows
+
+        def pad_rows(x):
+            if pad == 0:
+                return x
+            return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
+
+        obs_batch = map_structure(pad_rows, stack_structure([j[3] for j in jobs]))
+        use_hidden = any(self._hidden[i].get(p) is not None for i, p, _, _ in jobs)
+        hidden_batch = None
+        if use_hidden:
+            hidden_batch = map_structure(
+                pad_rows, stack_structure([self._hidden[i][p] for i, p, _, _ in jobs]))
+        outputs = self.wrapper.batch_inference(obs_batch, hidden_batch)
+        policies = np.asarray(outputs['policy'])
+        values = np.asarray(outputs['value']) if 'value' in outputs else None
+        returns_head = np.asarray(outputs['return']) if 'return' in outputs else None
+        next_hidden = outputs.get('hidden', None)
+
+        # scatter results back into per-env moments
+        pending: Dict[int, dict] = {}
+        for row, (i, player, acting, obs) in enumerate(jobs):
+            env = self.envs[i]
+            if i not in pending:
+                pending[i] = _blank_moment(env.players())
+                pending[i]['turn'] = env.turns()
+            moment = pending[i]
+            moment['observation'][player] = obs
+            if values is not None:
+                moment['value'][player] = values[row]
+            if next_hidden is not None:
+                self._hidden[i][player] = map_structure(
+                    lambda a: np.asarray(a)[row], next_hidden)
+            if acting:
+                action, prob, amask = _sample_action(
+                    policies[row], env.legal_actions(player))
+                moment['selected_prob'][player] = prob
+                moment['action_mask'][player] = amask
+                moment['action'][player] = action
+
+        finished: List[dict] = []
+        for i, moment in pending.items():
+            env = self.envs[i]
+            err = env.step(moment['action'])
+            if err:
+                self._reset_slot(i)
+                continue
+            reward = env.reward()
+            for player in env.players():
+                moment['reward'][player] = reward.get(player, None)
+            self._moments[i].append(moment)
+
+            if env.terminal():
+                episode = _finalize_episode(env, self._moments[i], self.args,
+                                            self._gen_args(env))
+                if episode is not None:
+                    finished.append(episode)
+                self._reset_slot(i)
+        return finished
+
+    def _reset_slot(self, i: int):
+        self._moments[i] = []
+        self.envs[i].reset()
+        self._hidden[i] = {p: self.wrapper.init_hidden()
+                           for p in self.envs[i].players()}

@@ -1,0 +1,260 @@
+"""Forward pass + loss composition: one pure, jittable function.
+
+Numerical parity targets: the reference training pipeline
+(train.py:127-267) — same masks, same importance-sampling clipping, same
+two-player value symmetrization and terminal bootstrap — rebuilt as a single
+XLA program:
+
+  * feed-forward nets: (B, T, P) folded into one batch dim — one big MXU
+    matmul stream instead of T small ones;
+  * recurrent nets: ``lax.scan`` over time with observation-mask-gated
+    hidden carry; burn-in steps run in a separate scan whose carry passes
+    through ``stop_gradient`` (the reference's no_grad replay,
+    train.py:159-162);
+  * turn-alternating batches (P_obs=1, P=2): the acting player's policy row
+    is gathered by multiplying with turn_mask and summing the player axis
+    (train.py:179-180); per-player hidden state is gated by
+    observation_mask and merged back after each step (train.py:153-173).
+
+Losses are sums (not means) so the EMA learning-rate schedule sees the true
+data count, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .targets import compute_target
+
+tmap = jax.tree_util.tree_map
+
+
+class LossConfig(NamedTuple):
+    """Hashable (static-arg) training configuration for the compiled step."""
+    turn_based_training: bool = True
+    observation: bool = False
+    burn_in_steps: int = 0
+    policy_target: str = 'TD'
+    value_target: str = 'TD'
+    lmb: float = 0.7
+    gamma: float = 0.8
+    entropy_regularization: float = 0.1
+    entropy_regularization_decay: float = 0.1
+
+    @classmethod
+    def from_args(cls, args: Dict[str, Any]) -> 'LossConfig':
+        return cls(
+            turn_based_training=bool(args['turn_based_training']),
+            observation=bool(args['observation']),
+            burn_in_steps=int(args['burn_in_steps']),
+            policy_target=str(args['policy_target']),
+            value_target=str(args['value_target']),
+            lmb=float(args['lambda']),
+            gamma=float(args['gamma']),
+            entropy_regularization=float(args['entropy_regularization']),
+            entropy_regularization_decay=float(args['entropy_regularization_decay']),
+        )
+
+
+def _fold_bt(x):
+    """(B, T, P, ...) -> (B*T*P, ...)"""
+    return x.reshape((-1,) + x.shape[3:])
+
+
+def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
+                       cfg: LossConfig) -> Dict[str, jnp.ndarray]:
+    """Run the net over a training window; returns time-major-stacked outputs
+    shaped (B, T, P, ...) with policy/value/return masking applied."""
+    observations = batch['observation']
+    B, T, P_obs = batch['action'].shape[:3]
+
+    if hidden is None:
+        obs = tmap(_fold_bt, observations)
+        outputs = apply_fn(params, obs, None)
+        outputs = {k: v.reshape((B, T, P_obs) + v.shape[1:])
+                   for k, v in outputs.items() if k != 'hidden' and v is not None}
+    else:
+        obs_tm = tmap(lambda o: jnp.moveaxis(o, 1, 0), observations)   # (T, B, P_obs, ...)
+        omask_tm = jnp.moveaxis(batch['observation_mask'], 1, 0)       # (T, B, P, 1)
+
+        def step(carry, x):
+            obs_t, omask_t = x
+            # gate each player's hidden by whether they observed this step
+            def gate(h):
+                m = omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
+                return h * m
+            gated = tmap(gate, carry)
+            if cfg.turn_based_training and not cfg.observation:
+                # only the turn player observed: summing the player axis
+                # selects their state (others were zeroed)
+                h_in = tmap(lambda h: h.sum(axis=1), gated)
+                obs_in = tmap(lambda o: o.reshape((B,) + o.shape[2:]), obs_t)
+            else:
+                h_in = tmap(lambda h: h.reshape((-1,) + h.shape[2:]), gated)
+                obs_in = tmap(lambda o: o.reshape((-1,) + o.shape[2:]), obs_t)
+            out = dict(apply_fn(params, obs_in, h_in))
+            next_h = out.pop('hidden')
+            out = {k: v.reshape((B, P_obs) + v.shape[1:])
+                   for k, v in out.items() if v is not None}
+            next_h = tmap(lambda h: h.reshape((B, -1) + h.shape[1:]), next_h)
+
+            def merge(h, nh):
+                m = omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
+                return h * (1 - m) + nh * m
+            carry = tmap(merge, carry, next_h)
+            return carry, out
+
+        bi = cfg.burn_in_steps
+        if bi > 0:
+            xs_burn = (tmap(lambda o: o[:bi], obs_tm), omask_tm[:bi])
+            hidden, _ = lax.scan(step, hidden, xs_burn)
+            hidden = lax.stop_gradient(hidden)
+        xs_main = (tmap(lambda o: o[bi:], obs_tm), omask_tm[bi:])
+        _, outputs_tm = lax.scan(step, hidden, xs_main)
+        outputs = {k: jnp.moveaxis(v, 0, 1) for k, v in outputs_tm.items()}
+
+        # re-attach zero outputs for burn-in steps so downstream slicing is
+        # uniform with the feed-forward path
+        if bi > 0:
+            outputs = {k: jnp.concatenate(
+                [jnp.zeros(v.shape[:1] + (bi,) + v.shape[2:], v.dtype), v], axis=1)
+                for k, v in outputs.items()}
+
+    masked = {}
+    for k, o in outputs.items():
+        if k == 'policy':
+            o = o * batch['turn_mask']
+            if o.shape[2] > 1 and P_obs == 1:
+                # turn-alternating batch: gather the acting player's row
+                o = o.sum(axis=2, keepdims=True)
+            masked[k] = o - batch['action_mask']
+        else:
+            masked[k] = o * batch['observation_mask']
+    return masked
+
+
+def _entropy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Categorical entropy over the last axis; -1e32-masked logits contribute
+    exactly zero (their probability underflows to 0 while the logit stays
+    finite)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -(jnp.exp(logp) * logp).sum(axis=-1)
+
+
+def compose_losses(outputs: Dict[str, jnp.ndarray],
+                   log_selected_policies: jnp.ndarray,
+                   total_advantages: jnp.ndarray,
+                   targets: Dict[str, Optional[jnp.ndarray]],
+                   batch: Dict[str, Any], cfg: LossConfig
+                   ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    tmasks = batch['turn_mask']
+    omasks = batch['observation_mask']
+
+    losses: Dict[str, jnp.ndarray] = {}
+    dcnt = tmasks.sum()
+
+    losses['p'] = (-log_selected_policies * total_advantages * tmasks).sum()
+    if 'value' in outputs:
+        losses['v'] = (((outputs['value'] - targets['value']) ** 2) * omasks).sum() / 2
+    if 'return' in outputs:
+        huber = optax_huber(outputs['return'], targets['return'])
+        losses['r'] = (huber * omasks).sum()
+
+    entropy = _entropy(outputs['policy']) * tmasks.sum(axis=-1)
+    losses['ent'] = entropy.sum()
+
+    base = losses['p'] + losses.get('v', 0) + losses.get('r', 0)
+    decay = 1 - batch['progress'] * (1 - cfg.entropy_regularization_decay)
+    entropy_loss = (entropy * decay).sum() * -cfg.entropy_regularization
+    losses['total'] = base + entropy_loss
+    return losses, dcnt
+
+
+def optax_huber(pred: jnp.ndarray, target: jnp.ndarray, delta: float = 1.0
+                ) -> jnp.ndarray:
+    """Smooth-L1 (huber, delta=1), elementwise."""
+    err = pred - target
+    abs_err = jnp.abs(err)
+    quad = jnp.minimum(abs_err, delta)
+    return 0.5 * quad ** 2 + delta * (abs_err - quad)
+
+
+def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
+                 cfg: LossConfig) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Full pipeline: forward, targets, advantages, composed losses.
+
+    Returns (total_loss, aux) where aux carries per-term sums and the data
+    count for the EMA lr schedule.
+    """
+    outputs = forward_prediction(apply_fn, params, init_hidden, batch, cfg)
+
+    bi = cfg.burn_in_steps
+    if bi > 0:
+        batch = _slice_burn_in(batch, bi)
+        outputs = {k: v[:, bi:] for k, v in outputs.items()}
+
+    actions = batch['action']
+    emasks = batch['episode_mask']
+    omasks = batch['observation_mask']
+    value_target_masks = omasks
+
+    clip_rho, clip_c = 1.0, 1.0
+
+    log_b = jnp.log(jnp.clip(batch['selected_prob'], 1e-16, 1)) * emasks
+    logp = jax.nn.log_softmax(outputs['policy'], axis=-1)
+    log_t = jnp.take_along_axis(logp, actions, axis=-1) * emasks
+
+    log_rhos = lax.stop_gradient(log_t) - log_b
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.clip(rhos, 0, clip_rho)
+    cs = jnp.clip(rhos, 0, clip_c)
+    outputs_nograd = {k: lax.stop_gradient(v) for k, v in outputs.items()}
+
+    if 'value' in outputs_nograd:
+        values_nograd = outputs_nograd['value']
+        if cfg.turn_based_training and values_nograd.shape[2] == 2:
+            # two-player zero-sum: each player's estimate is blended with the
+            # negation of the opponent's (train.py:243-247)
+            values_opp = -jnp.flip(values_nograd, axis=2)
+            omasks_opp = jnp.flip(omasks, axis=2)
+            values_nograd = ((values_nograd * omasks + values_opp * omasks_opp)
+                             / (omasks + omasks_opp + 1e-8))
+            value_target_masks = jnp.clip(omasks + omasks_opp, 0, 1)
+        # bootstrap padded steps beyond episode end with the final outcome
+        outputs_nograd['value'] = (values_nograd * emasks
+                                   + batch['outcome'] * (1 - emasks))
+
+    targets: Dict[str, Any] = {}
+    advantages: Dict[str, Any] = {}
+
+    value_args = (outputs_nograd.get('value', None), batch['outcome'], None,
+                  cfg.lmb, 1.0, clipped_rhos, cs, value_target_masks)
+    return_args = (outputs_nograd.get('return', None), batch['return'],
+                   batch['reward'], cfg.lmb, cfg.gamma, clipped_rhos, cs, omasks)
+
+    targets['value'], advantages['value'] = compute_target(cfg.value_target, *value_args)
+    targets['return'], advantages['return'] = compute_target(cfg.value_target, *return_args)
+
+    if cfg.policy_target != cfg.value_target:
+        _, advantages['value'] = compute_target(cfg.policy_target, *value_args)
+        _, advantages['return'] = compute_target(cfg.policy_target, *return_args)
+
+    total_advantages = clipped_rhos * sum(advantages.values())
+
+    losses, dcnt = compose_losses(outputs, log_t, total_advantages, targets,
+                                  batch, cfg)
+    aux = {'losses': losses, 'data_count': dcnt}
+    return losses['total'], aux
+
+
+def _slice_burn_in(batch: Dict[str, Any], bi: int) -> Dict[str, Any]:
+    """Drop burn-in steps from every time-indexed entry (time-size-1 entries
+    like outcome pass through, mirroring train.py:221)."""
+    def cut(v):
+        return v if v.shape[1] <= 1 else v[:, bi:]
+    return {k: tmap(cut, v) if isinstance(v, dict) else cut(v)
+            for k, v in batch.items()}

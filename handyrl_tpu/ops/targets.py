@@ -20,7 +20,6 @@ ratios rho/c clipped upstream by the loss pipeline.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -113,7 +112,6 @@ def vtrace(values: Array, returns: Array, rewards: Optional[Array],
     return _bf(vs), _bf(advantages)
 
 
-@partial(jax.jit, static_argnames=('algorithm', 'gamma'))
 def compute_target(algorithm: str, values: Optional[Array], returns: Array,
                    rewards: Optional[Array], lmb: float, gamma: float,
                    rhos: Array, cs: Array, masks: Array

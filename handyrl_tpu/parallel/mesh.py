@@ -1,0 +1,47 @@
+"""Device mesh and sharding helpers.
+
+Replaces the reference's single-node nn.DataParallel (train.py:339-340) with
+jax.sharding over a named mesh: the batch is sharded along 'data', params are
+replicated, and XLA inserts the gradient all-reduce over ICI. A 'model' axis
+is reserved so tensor-parallel specs can be added without changing call
+sites.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+def make_mesh(devices: Optional[Sequence] = None, model_parallel: int = 1) -> Mesh:
+    """(n/model_parallel, model_parallel) mesh over the given devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % model_parallel == 0, (n, model_parallel)
+    grid = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim split across the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device-put a host batch with its leading dim sharded over 'data'."""
+    spec = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), batch)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k

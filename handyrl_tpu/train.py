@@ -1,0 +1,494 @@
+"""Learner orchestration: trainer loop, batch prefetch, epoch cadence.
+
+Architecture (counterpart of the reference train.py, reshaped for TPU):
+
+  * ``Trainer`` — background thread owning the jit/pjit-compiled update step
+    (ops/train_step.py). The Adam step, clipping, and losses all live on
+    device; the host only feeds batches and the EMA-scheduled learning rate
+    (lr = 3e-8 * data_cnt_ema / (1 + steps*1e-5), reference
+    train.py:327-331,382-384). On a multi-device mesh the batch is sharded
+    over 'data' and XLA all-reduces gradients over ICI (replacing
+    nn.DataParallel).
+
+  * ``Batcher`` — prefetch threads turning buffered episodes into batches
+    (recency-biased window sampling, ops/batch.py) ahead of the update step.
+
+  * ``Learner`` — episode/eval accounting, epoch cadence (update every
+    ``update_episodes`` returned episodes), checkpointing
+    (models/<epoch>.ckpt msgpack params — loading cannot execute code), and
+    two generation front-ends:
+      - in-process ``BatchedGenerator`` (TPU-first default): N envs against
+        one batched device inference;
+      - the 4-RPC worker protocol ('args'/'episode'/'result'/'model') over
+        WorkerCluster (local processes) or WorkerServer (remote hosts),
+        wire-compatible in shape with the reference (train.py:541-627).
+
+Log line formats (epoch / win rate / generation stats / loss / updated
+model) match the reference so its plot tooling carries over (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import queue
+import random
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import psutil
+
+from .environment import make_env, prepare_env
+from .generation import BatchedGenerator
+from .evaluation import Evaluator
+from .model import ModelWrapper, RandomModel
+from .ops.batch import make_batch, select_episode
+from .ops.losses import LossConfig
+from .ops.train_step import TrainState, build_update_step, init_train_state
+from .parallel.mesh import make_mesh, shard_batch
+from .worker import WorkerCluster, WorkerServer
+
+
+class Batcher:
+    """Threaded batch prefetcher over the shared episode deque."""
+
+    def __init__(self, args: Dict[str, Any], episodes: deque):
+        self.args = args
+        self.episodes = episodes
+        self.output_queue: queue.Queue = queue.Queue(maxsize=8)
+        self._started = False
+
+    def run(self):
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.args['num_batchers']):
+            threading.Thread(target=self._worker, args=(i,), daemon=True).start()
+
+    def _worker(self, bid: int):
+        print('started batcher %d' % bid)
+        while True:
+            try:
+                selected = [select_episode(self.episodes, self.args)
+                            for _ in range(self.args['batch_size'])]
+                batch = make_batch(selected, self.args)
+            except (IndexError, ValueError):
+                time.sleep(0.1)
+                continue
+            self.output_queue.put(batch)
+
+    def batch(self):
+        return self.output_queue.get()
+
+
+class Trainer:
+    """SGD loop thread: compiled update step + EMA learning-rate schedule."""
+
+    def __init__(self, args: Dict[str, Any], wrapper: ModelWrapper):
+        self.args = args
+        self.wrapper = wrapper
+        self.episodes: deque = deque()
+        self.cfg = LossConfig.from_args(args)
+
+        n_dev = len(jax.devices())
+        self.mesh = make_mesh() if n_dev > 1 else None
+        self.update_step = build_update_step(wrapper.module, self.cfg,
+                                             self.mesh, donate=False)
+        self.state: Optional[TrainState] = (
+            init_train_state(wrapper.params) if wrapper.params is not None else None)
+
+        self.default_lr = 3e-8
+        self.data_cnt_ema = args['batch_size'] * args['forward_steps']
+        self.steps = 0
+        self.batcher = Batcher(args, self.episodes)
+        self.update_flag = False
+        self.update_queue: queue.Queue = queue.Queue(maxsize=1)
+        self._loss_sum: Dict[str, float] = {}
+
+    def _lr(self) -> float:
+        return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
+
+    def update(self):
+        """Called by the learner at each epoch boundary; blocks until the
+        trainer hands over the new params."""
+        self.update_flag = True
+        params, steps = self.update_queue.get()
+        return params, steps
+
+    def train(self):
+        if self.state is None:   # non-parametric model
+            time.sleep(0.1)
+            return self.wrapper.params
+
+        batch_cnt, data_cnt = 0, 0
+        pending_metrics: List[Dict[str, jnp.ndarray]] = []
+
+        while data_cnt == 0 or not self.update_flag:
+            batch = self.batcher.batch()
+            if self.mesh is not None:
+                batch = shard_batch(self.mesh, batch)
+            lr = jnp.asarray(self._lr(), jnp.float32)
+            self.state, metrics = self.update_step(self.state, batch, lr)
+            pending_metrics.append(metrics)
+            batch_cnt += 1
+            # data_count is a device scalar; fetch lazily every few steps to
+            # avoid a sync per update
+            if len(pending_metrics) >= 8:
+                data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
+                self._drain_metrics(pending_metrics)
+                pending_metrics = []
+            self.steps += 1
+
+        if pending_metrics:
+            data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
+            self._drain_metrics(pending_metrics)
+
+        loss_sum = self._loss_sum
+        self._loss_sum = {}
+        print('loss = %s' % ' '.join(
+            [k + ':' + '%.3f' % (l / max(data_cnt, 1)) for k, l in loss_sum.items()]))
+
+        self.data_cnt_ema = (self.data_cnt_ema * 0.8
+                             + data_cnt / (1e-2 + batch_cnt) * 0.2)
+        return jax.tree_util.tree_map(np.asarray, self.state.params)
+
+    def _drain_metrics(self, pending: List[Dict[str, Any]]):
+        for m in pending:
+            for k, v in m.items():
+                if k == 'data_count':
+                    continue
+                self._loss_sum[k] = self._loss_sum.get(k, 0.0) + float(v)
+
+    def run(self):
+        print('waiting training')
+        while len(self.episodes) < self.args['minimum_episodes']:
+            time.sleep(1)
+        if self.state is not None:
+            self.batcher.run()
+            print('started training')
+        while True:
+            params = self.train()
+            self.update_flag = False
+            self.update_queue.put((params, self.steps))
+
+
+class Learner:
+    """Central conductor: owns the model, episode/eval accounting, epoch
+    cadence, checkpoints, and the generation front-end."""
+
+    def __init__(self, args: Dict[str, Any], net=None, remote: bool = False):
+        train_args = args['train_args']
+        env_args = args['env_args']
+        train_args['env'] = env_args
+        args = train_args
+
+        self.args = args
+        random.seed(args['seed'])
+
+        self.env = make_env(env_args)
+        eval_modify_rate = (args['update_episodes'] ** 0.85) / args['update_episodes']
+        self.eval_rate = max(args['eval_rate'], eval_modify_rate)
+        self.shutdown_flag = False
+        self.flags: set = set()
+
+        self.model_epoch = args['restart_epoch']
+        module = net if net is not None else self.env.net()
+        self.wrapper = ModelWrapper(module, seed=args['seed'])
+        self.env.reset()
+        self._example_obs = self.env.observation(self.env.players()[0])
+        self.wrapper.ensure_params(self._example_obs)
+        if self.model_epoch > 0:
+            with open(self.model_path(self.model_epoch), 'rb') as f:
+                self.wrapper.load_params_bytes(f.read(), self._example_obs)
+
+        # generation accounting
+        self.generation_results: Dict[int, tuple] = {}
+        self.num_episodes = 0
+        self.num_returned_episodes = 0
+        # evaluation accounting
+        self.results: Dict[int, tuple] = {}
+        self.results_per_opponent: Dict[int, dict] = {}
+        self.num_results = 0
+
+        self.remote = remote
+        self.use_batched_generation = (not remote
+                                       and args.get('batched_generation', True))
+        self.worker = None
+        if not self.use_batched_generation:
+            self.worker = WorkerServer(args) if remote else WorkerCluster(args)
+
+        self.trainer = Trainer(args, self.wrapper)
+
+        self._metrics_path = args.get('metrics_jsonl') or ''
+
+    # -- checkpoints ------------------------------------------------------
+    def model_path(self, model_id: int) -> str:
+        return os.path.join(self.args.get('model_dir', 'models'),
+                            str(model_id) + '.ckpt')
+
+    def latest_model_path(self) -> str:
+        return os.path.join(self.args.get('model_dir', 'models'), 'latest.ckpt')
+
+    def update_model(self, params, steps: int):
+        print('updated model(%d)' % steps)
+        self.model_epoch += 1
+        self.wrapper.params = jax.tree_util.tree_map(jnp.asarray, params)
+        os.makedirs(self.args.get('model_dir', 'models'), exist_ok=True)
+        raw = self.wrapper.params_bytes()
+        for path in (self.model_path(self.model_epoch), self.latest_model_path()):
+            with open(path, 'wb') as f:
+                f.write(raw)
+
+    # -- accounting -------------------------------------------------------
+    def feed_episodes(self, episodes: List[Optional[dict]]):
+        for episode in episodes:
+            if episode is None:
+                continue
+            for p in episode['args']['player']:
+                model_id = self.model_epoch
+                outcome = episode['outcome'][p]
+                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
+                self.generation_results[model_id] = (n + 1, r + outcome,
+                                                     r2 + outcome ** 2)
+            self.num_returned_episodes += 1
+            if self.num_returned_episodes % 100 == 0:
+                print(self.num_returned_episodes, end=' ', flush=True)
+
+        self.trainer.episodes.extend([e for e in episodes if e is not None])
+
+        mem_percent = psutil.virtual_memory().percent
+        mem_ok = mem_percent <= 95
+        maximum_episodes = (self.args['maximum_episodes'] if mem_ok else
+                            int(len(self.trainer.episodes) * 95 / mem_percent))
+        if not mem_ok and 'memory_over' not in self.flags:
+            warnings.warn('memory usage %.1f%% with buffer size %d' %
+                          (mem_percent, len(self.trainer.episodes)))
+            self.flags.add('memory_over')
+        while len(self.trainer.episodes) > maximum_episodes:
+            self.trainer.episodes.popleft()
+
+    def feed_results(self, results: List[Optional[dict]]):
+        for result in results:
+            if result is None:
+                continue
+            for p in result['args']['player']:
+                model_id = self.model_epoch
+                res = result['result'][p]
+                n, r, r2 = self.results.get(model_id, (0, 0, 0))
+                self.results[model_id] = (n + 1, r + res, r2 + res ** 2)
+                opp_map = self.results_per_opponent.setdefault(model_id, {})
+                opponent = result['opponent']
+                n, r, r2 = opp_map.get(opponent, (0, 0, 0))
+                opp_map[opponent] = (n + 1, r + res, r2 + res ** 2)
+
+    # -- epoch boundary ---------------------------------------------------
+    def update(self):
+        print()
+        print('epoch %d' % self.model_epoch)
+
+        if self.model_epoch not in self.results:
+            print('win rate = Nan (0)')
+        else:
+            def output_wp(name, results):
+                n, r, r2 = results
+                mean = r / (n + 1e-6)
+                name_tag = ' (%s)' % name if name != '' else ''
+                print('win rate%s = %.3f (%.1f / %d)'
+                      % (name_tag, (mean + 1) / 2, (r + n) / 2, n))
+
+            keys = self.results_per_opponent[self.model_epoch]
+            if (len(self.args.get('eval', {}).get('opponent', [])) <= 1
+                    and len(keys) <= 1):
+                output_wp('', self.results[self.model_epoch])
+            else:
+                output_wp('total', self.results[self.model_epoch])
+                for key in sorted(keys):
+                    output_wp(key, keys[key])
+
+        if self.model_epoch not in self.generation_results:
+            print('generation stats = Nan (0)')
+        else:
+            n, r, r2 = self.generation_results[self.model_epoch]
+            mean = r / (n + 1e-6)
+            std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
+            print('generation stats = %.3f +- %.3f' % (mean, std))
+
+        params, steps = self.trainer.update()
+        if params is None:
+            params = self.wrapper.params
+        self.update_model(params, steps)
+        self._write_metrics(steps)
+        self.flags = set()
+
+    def _write_metrics(self, steps: int):
+        if not self._metrics_path:
+            return
+        rec = {'epoch': self.model_epoch, 'steps': steps,
+               'episodes': self.num_returned_episodes, 'time': time.time()}
+        gen = self.generation_results.get(self.model_epoch - 1)
+        if gen:
+            n, r, _ = gen
+            rec['generation_mean'] = r / (n + 1e-6)
+        ev = self.results.get(self.model_epoch - 1)
+        if ev:
+            n, r, _ = ev
+            rec['win_rate'] = (r / (n + 1e-6) + 1) / 2
+        with open(self._metrics_path, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+
+    # -- generation front-end A: in-process batched self-play -------------
+    def _run_batched(self):
+        """TPU-first local mode: vectorized self-play + interleaved eval in
+        this process; no worker processes at all."""
+        args = self.args
+        actor = ModelWrapper(self.wrapper.module)
+        actor.params = self.wrapper.params
+        env_args = args['env']
+
+        def make_env_fn(i):
+            e = make_env({**env_args, 'id': i})
+            return e
+
+        gen = BatchedGenerator(make_env_fn, actor, args,
+                               n_envs=args.get('generation_envs', 64))
+        eval_env = make_env(env_args)
+        evaluator = Evaluator(eval_env, args)
+        random_model = RandomModel(self.wrapper, self._example_obs)
+
+        prev_update_episodes = args['minimum_episodes']
+        next_update_episodes = prev_update_episodes + args['update_episodes']
+
+        while not self.shutdown_flag:
+            actor.params = self.wrapper.params   # follow latest epoch
+            episodes = gen.step()
+            for ep in episodes:
+                self.num_episodes += 1
+            self.feed_episodes(episodes)
+
+            # keep evaluation share at eval_rate, mirroring the role split
+            while self.num_results < self.eval_rate * self.num_episodes:
+                p = self.env.players()[self.num_results % len(self.env.players())]
+                models = {q: (actor if q == p else None)
+                          for q in self.env.players()}
+                eval_args = {'role': 'e', 'player': [p],
+                             'model_id': {q: (self.model_epoch if q == p else -1)
+                                          for q in self.env.players()}}
+                self.num_results += 1
+                self.feed_results([evaluator.execute(models, eval_args)])
+
+            if self.num_returned_episodes >= next_update_episodes:
+                prev_update_episodes = next_update_episodes
+                next_update_episodes = (prev_update_episodes
+                                        + args['update_episodes'])
+                self.update()
+                if 0 <= self.args['epochs'] <= self.model_epoch:
+                    self.shutdown_flag = True
+
+    # -- generation front-end B: RPC server over workers ------------------
+    def server(self):
+        """4-RPC conductor: args / episode / result / model
+        (reference train.py:541-627; 'model' answers with an architecture
+        name + msgpack params snapshot, never pickled code)."""
+        print('started server')
+        prev_update_episodes = self.args['minimum_episodes']
+        next_update_episodes = prev_update_episodes + self.args['update_episodes']
+
+        while self.worker.connection_count() > 0 or not self.shutdown_flag:
+            try:
+                conn, (req, data) = self.worker.recv(timeout=0.3)
+            except queue.Empty:
+                continue
+
+            multi_req = isinstance(data, list)
+            if not multi_req:
+                data = [data]
+            send_data = []
+
+            if req == 'args':
+                if self.shutdown_flag:
+                    send_data = [None] * len(data)
+                else:
+                    for _ in data:
+                        role_args = {'model_id': {}}
+                        if self.num_results < self.eval_rate * self.num_episodes:
+                            role_args['role'] = 'e'
+                        else:
+                            role_args['role'] = 'g'
+
+                        if role_args['role'] == 'g':
+                            role_args['player'] = self.env.players()
+                            for p in self.env.players():
+                                role_args['model_id'][p] = self.model_epoch
+                            self.num_episodes += 1
+                        else:
+                            players = self.env.players()
+                            role_args['player'] = [
+                                players[self.num_results % len(players)]]
+                            for p in players:
+                                role_args['model_id'][p] = (
+                                    self.model_epoch if p in role_args['player']
+                                    else -1)
+                            self.num_results += 1
+                        send_data.append(role_args)
+
+            elif req == 'episode':
+                self.feed_episodes(data)
+                send_data = [None] * len(data)
+
+            elif req == 'result':
+                self.feed_results(data)
+                send_data = [None] * len(data)
+
+            elif req == 'model':
+                for model_id in data:
+                    snap = None
+                    if model_id == self.model_epoch or model_id <= 0:
+                        snap = self.wrapper.snapshot()
+                    else:
+                        try:
+                            with open(self.model_path(model_id), 'rb') as f:
+                                snap = {'architecture':
+                                        self.wrapper.snapshot()['architecture'],
+                                        'params': f.read()}
+                        except OSError:
+                            snap = self.wrapper.snapshot()
+                    send_data.append(snap)
+
+            if not multi_req and len(send_data) == 1:
+                send_data = send_data[0]
+            self.worker.send(conn, send_data)
+
+            if self.num_returned_episodes >= next_update_episodes:
+                prev_update_episodes = next_update_episodes
+                next_update_episodes = (prev_update_episodes
+                                        + self.args['update_episodes'])
+                self.update()
+                if 0 <= self.args['epochs'] <= self.model_epoch:
+                    self.shutdown_flag = True
+        print('finished server')
+
+    def run(self):
+        threading.Thread(target=self.trainer.run, daemon=True).start()
+        if self.use_batched_generation:
+            self._run_batched()
+        else:
+            self.worker.run()
+            self.server()
+
+
+def train_main(args):
+    prepare_env(args['env_args'])
+    learner = Learner(args=args)
+    learner.run()
+
+
+def train_server_main(args):
+    learner = Learner(args=args, remote=True)
+    learner.run()

@@ -1,0 +1,278 @@
+"""Worker processes, gather fan-in proxies, and cluster front-ends.
+
+Topology parity with the reference (worker.py): learner -> gathers (one per
+~16 workers, amortizing RPCs via request prefetch, model caching, and result
+batching) -> workers running Generator/Evaluator episodes. Local mode forks
+processes over mp.Pipe; remote mode connects over TCP with an entry
+handshake on port 9999 (base_worker_id assignment + merged config) and data
+connections on port 9998.
+
+Differences from the reference: the 'model' RPC answers with an
+architecture-name + msgpack-params snapshot (model.ModelWrapper.snapshot)
+instead of a pickled nn.Module (reference worker.py:46-47) — a worker can
+reconstruct the model without trusting the wire to carry code.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import multiprocessing as mp
+import queue
+import random
+import threading
+import time
+from collections import deque
+from socket import gethostname
+from typing import Any, Dict
+
+from .connection import (QueueCommunicator, accept_socket_connections,
+                         connect_socket_connection,
+                         open_multiprocessing_connections, send_recv)
+from .environment import make_env, prepare_env
+from .evaluation import Evaluator
+from .generation import Generator
+from .model import ModelWrapper, RandomModel
+
+
+class Worker:
+    """Episode/evaluation executor: request loop over the 4-RPC protocol."""
+
+    def __init__(self, args: Dict[str, Any], conn, wid: int):
+        print('opened worker %d' % wid)
+        self.worker_id = wid
+        self.args = args
+        self.conn = conn
+        self.model_pool: Dict[int, Any] = {}
+
+        self.env = make_env({**args['env'], 'id': wid})
+        self.generator = Generator(self.env, self.args)
+        self.evaluator = Evaluator(self.env, self.args)
+
+        random.seed(args['seed'] + wid)
+
+    def __del__(self):
+        print('closed worker %d' % self.worker_id)
+
+    def _example_obs(self):
+        self.env.reset()
+        return self.env.observation(self.env.players()[0])
+
+    def _gather_models(self, model_ids):
+        for model_id in model_ids:
+            if model_id is None or model_id < 0 or model_id in self.model_pool:
+                continue
+            snap = send_recv(self.conn, ('model', model_id))
+            wrapper = ModelWrapper.from_snapshot(snap, self._example_obs())
+            if model_id == 0:
+                # epoch 0 means an untrained net: play uniformly at random
+                wrapper = RandomModel(wrapper, self._example_obs())
+            # single-slot cache: evict the oldest entry
+            if len(self.model_pool) >= 1:
+                self.model_pool.pop(next(iter(self.model_pool)))
+            self.model_pool[model_id] = wrapper
+
+    def run(self):
+        while True:
+            role_args = send_recv(self.conn, ('args', None))
+            if role_args is None:
+                break
+            role = role_args['role']
+
+            models = {}
+            if 'model_id' in role_args:
+                self._gather_models(list(role_args['model_id'].values()))
+                for p, model_id in role_args['model_id'].items():
+                    models[p] = self.model_pool.get(model_id, None)
+
+            if role == 'g':
+                episode = self.generator.execute(models, role_args)
+                send_recv(self.conn, ('episode', episode))
+            elif role == 'e':
+                result = self.evaluator.execute(models, role_args)
+                send_recv(self.conn, ('result', result))
+
+
+def _worker_args(args, n_gathers, gather_id, base_wid, wid, conn):
+    return args, conn, base_wid + wid * n_gathers + gather_id
+
+
+def open_worker(args, conn, wid):
+    worker = Worker(args, conn, wid)
+    worker.run()
+
+
+class Gather(QueueCommunicator):
+    """Fan-in proxy for ~16 workers: prefetches 'args' from the server in
+    bulk, caches 'model' responses by id, and flushes episodes/results in
+    batches (reference worker.py:92-161)."""
+
+    def __init__(self, args: Dict[str, Any], conn, gather_id: int):
+        print('started gather %d' % gather_id)
+        super().__init__()
+        self.gather_id = gather_id
+        self.server_conn = conn
+        self.args_queue: deque = deque()
+        self.data_map: Dict[str, dict] = {'model': {}}
+        self.result_send_map: Dict[str, list] = {}
+        self.result_send_cnt = 0
+
+        n_pro = args['worker']['num_parallel']
+        n_ga = args['worker']['num_gathers']
+        num_workers_here = (n_pro // n_ga) + int(gather_id < n_pro % n_ga)
+        base_wid = args['worker'].get('base_worker_id', 0)
+
+        worker_conns = open_multiprocessing_connections(
+            num_workers_here, open_worker,
+            functools.partial(_worker_args, args, n_ga, gather_id, base_wid))
+        for wconn in worker_conns:
+            self.add_connection(wconn)
+
+        self.buffer_length = 1 + len(worker_conns) // 4
+
+    def __del__(self):
+        print('finished gather %d' % self.gather_id)
+
+    def run(self):
+        while self.connection_count() > 0:
+            try:
+                conn, (command, args) = self.recv(timeout=0.3)
+            except queue.Empty:
+                continue
+
+            if command == 'args':
+                if len(self.args_queue) == 0:
+                    self.server_conn.send((command, [None] * self.buffer_length))
+                    self.args_queue += self.server_conn.recv()
+                self.send(conn, self.args_queue.popleft())
+
+            elif command in self.data_map:
+                data_id = args
+                if data_id not in self.data_map[command]:
+                    self.server_conn.send((command, args))
+                    self.data_map[command][data_id] = self.server_conn.recv()
+                self.send(conn, self.data_map[command][data_id])
+
+            else:
+                # ack immediately, ship to the server in bulk later
+                self.send(conn, None)
+                self.result_send_map.setdefault(command, []).append(args)
+                self.result_send_cnt += 1
+                if self.result_send_cnt >= self.buffer_length:
+                    for cmd, args_list in self.result_send_map.items():
+                        self.server_conn.send((cmd, args_list))
+                        self.server_conn.recv()
+                    self.result_send_map = {}
+                    self.result_send_cnt = 0
+
+
+def gather_loop(args, conn, gather_id):
+    gather = Gather(args, conn, gather_id)
+    gather.run()
+
+
+def default_num_gathers(num_parallel: int) -> int:
+    return 1 + max(0, num_parallel - 1) // 16
+
+
+class WorkerCluster(QueueCommunicator):
+    """Local mode: fork gather processes connected by mp.Pipe."""
+
+    def __init__(self, args: Dict[str, Any]):
+        super().__init__()
+        self.args = args
+
+    def run(self):
+        if 'num_gathers' not in self.args['worker']:
+            self.args['worker']['num_gathers'] = \
+                default_num_gathers(self.args['worker']['num_parallel'])
+        for i in range(self.args['worker']['num_gathers']):
+            conn0, conn1 = mp.Pipe(duplex=True)
+            mp.Process(target=gather_loop, args=(self.args, conn1, i),
+                       daemon=True).start()
+            conn1.close()
+            self.add_connection(conn0)
+
+
+class WorkerServer(QueueCommunicator):
+    """Remote mode, learner side: entry handshake on :9999 (assigns
+    base_worker_id, returns merged config), worker data conns on :9998.
+    Workers may join or leave at any time."""
+
+    ENTRY_PORT = 9999
+    WORKER_PORT = 9998
+
+    def __init__(self, args: Dict[str, Any]):
+        super().__init__()
+        self.args = args
+        self.total_worker_count = 0
+
+    def run(self):
+        def entry_server(port):
+            print('started entry server %d' % port)
+            for conn in accept_socket_connections(port=port):
+                worker_args = conn.recv()
+                print('accepted connection from %s!' % worker_args['address'])
+                worker_args['base_worker_id'] = self.total_worker_count
+                self.total_worker_count += worker_args['num_parallel']
+                args = copy.deepcopy(self.args)
+                args['worker'] = worker_args
+                conn.send(args)
+                conn.close()
+
+        def worker_server(port):
+            print('started worker server %d' % port)
+            for conn in accept_socket_connections(port=port):
+                self.add_connection(conn)
+
+        threading.Thread(target=entry_server, args=(self.ENTRY_PORT,),
+                         daemon=True).start()
+        threading.Thread(target=worker_server, args=(self.WORKER_PORT,),
+                         daemon=True).start()
+
+
+def entry(worker_args):
+    conn = connect_socket_connection(worker_args['server_address'],
+                                     WorkerServer.ENTRY_PORT)
+    conn.send(worker_args)
+    args = conn.recv()
+    conn.close()
+    return args
+
+
+class RemoteWorkerCluster:
+    """Remote mode, worker-host side: entry handshake then one socket per
+    gather."""
+
+    def __init__(self, args: Dict[str, Any]):
+        args['address'] = gethostname()
+        if 'num_gathers' not in args:
+            args['num_gathers'] = default_num_gathers(args['num_parallel'])
+        self.args = args
+
+    def run(self):
+        args = entry(self.args)
+        print(args)
+        prepare_env(args['env'])
+
+        processes = []
+        try:
+            for i in range(self.args['num_gathers']):
+                conn = connect_socket_connection(self.args['server_address'],
+                                                 WorkerServer.WORKER_PORT)
+                p = mp.Process(target=gather_loop, args=(args, conn, i))
+                p.start()
+                conn.close()
+                processes.append(p)
+            while True:
+                time.sleep(100)
+        finally:
+            for p in processes:
+                p.terminate()
+
+
+def worker_main(args, argv):
+    worker_args = args['worker_args']
+    if len(argv) >= 1:
+        worker_args['num_parallel'] = int(argv[0])
+    RemoteWorkerCluster(args=worker_args).run()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""handyrl_tpu CLI: train / train-server / worker / eval / eval-server /
+eval-client, mirroring the reference's six modes (main.py:19-38)."""
+
+import sys
+
+from handyrl_tpu.config import load_config
+
+USAGE = """usage: python main.py MODE [args]
+modes:
+  --train, -t          stand-alone training on this host
+  --train-server, -ts  training server awaiting remote workers
+  --worker, -w         worker host feeding a training server [num_parallel]
+  --eval, -e           evaluate MODEL_PATH[:OPPONENT] [NUM_GAMES [NUM_PROC]]
+  --eval-server, -es   network battle server [NUM_GAMES [NUM_PROC]]
+  --eval-client, -ec   network battle client MODEL_PATH [HOST]
+"""
+
+
+def main():
+    args = load_config('config.yaml')
+    print(args)
+
+    if len(sys.argv) < 2:
+        print(USAGE)
+        sys.exit(1)
+
+    mode = sys.argv[1]
+    rest = sys.argv[2:]
+
+    if mode in ('--train', '-t'):
+        from handyrl_tpu.train import train_main
+        train_main(args)
+    elif mode in ('--train-server', '-ts'):
+        from handyrl_tpu.train import train_server_main
+        train_server_main(args)
+    elif mode in ('--worker', '-w'):
+        from handyrl_tpu.worker import worker_main
+        worker_main(args, rest)
+    elif mode in ('--eval', '-e'):
+        from handyrl_tpu.evaluation import eval_main
+        eval_main(args, rest)
+    elif mode in ('--eval-server', '-es'):
+        from handyrl_tpu.evaluation import eval_server_main
+        eval_server_main(args, rest)
+    elif mode in ('--eval-client', '-ec'):
+        from handyrl_tpu.evaluation import eval_client_main
+        eval_client_main(args, rest)
+    else:
+        print('Not found mode %s.' % mode)
+        print(USAGE)
+
+
+if __name__ == '__main__':
+    main()

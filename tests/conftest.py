@@ -1,7 +1,13 @@
 """Test harness configuration.
 
-Force JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere, so
-all sharding/pjit code paths run the same program they would on a TPU slice.
+Force JAX onto a virtual 8-device CPU mesh so all sharding/pjit code paths
+run the same program they would on a TPU slice.
+
+Note: the env var alone is not enough in this image — the axon TPU plugin's
+site registration overrides jax_platforms at import time, and its backend
+init blocks if another process holds the single TPU tunnel. The explicit
+``jax.config.update`` below wins over that and keeps the test suite fully
+off-device (so it can run in parallel with a training/bench process).
 """
 
 import os
@@ -10,3 +16,7 @@ os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,54 @@
+"""Shared test fixtures: synthetic episodes and batch windows."""
+
+import numpy as np
+
+from handyrl_tpu.ops.batch import compress_moments
+
+
+def turn_based_episode(steps=5, obs_shape=(3, 3, 3), n_actions=9, seed=None):
+    """Synthetic 2-player turn-alternating episode: player t%2 acts at step t."""
+    rng = np.random.RandomState(seed if seed is not None else 0)
+    moments = []
+    for t in range(steps):
+        turn = t % 2
+        m = {key: {0: None, 1: None} for key in
+             ('observation', 'selected_prob', 'action_mask', 'action',
+              'value', 'reward', 'return')}
+        m['observation'][turn] = rng.rand(*obs_shape).astype(np.float32)
+        m['selected_prob'][turn] = 0.5
+        amask = np.full(n_actions, 1e32, np.float32)
+        amask[:3] = 0
+        m['action_mask'][turn] = amask
+        m['action'][turn] = t % 3
+        m['value'][turn] = np.array([0.1 * t], np.float32)
+        m['reward'] = {0: 0.0, 1: 0.0}
+        m['return'] = {0: 0.25, 1: -0.25}
+        m['turn'] = [turn]
+        moments.append(m)
+    return {
+        'args': {'player': [0, 1]}, 'steps': steps,
+        'outcome': {0: 1.0, 1: -1.0},
+        'moment': compress_moments(moments, compress_steps=2),
+    }
+
+
+def train_args(forward_steps=4, burn_in=0, observation=False, turn_based=True):
+    return {
+        'turn_based_training': turn_based, 'observation': observation,
+        'forward_steps': forward_steps, 'burn_in_steps': burn_in,
+        'compress_steps': 2, 'maximum_episodes': 100,
+        'lambda': 0.7, 'gamma': 0.8,
+        'policy_target': 'TD', 'value_target': 'TD',
+        'entropy_regularization': 0.1, 'entropy_regularization_decay': 0.1,
+    }
+
+
+def window(ep, start, end, train_start=None, cs=2):
+    st_block, ed_block = start // cs, (end - 1) // cs + 1
+    return {
+        'args': ep['args'], 'outcome': ep['outcome'],
+        'moment': ep['moment'][st_block:ed_block], 'base': st_block * cs,
+        'start': start, 'end': end,
+        'train_start': start if train_start is None else train_start,
+        'total': ep['steps'],
+    }
