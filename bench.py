@@ -1,0 +1,80 @@
+"""Headline benchmark: learner trajectories/sec on the flagship config.
+
+Measures the full compiled update step (forward + targets + losses + grads +
+Adam) on GeeseNet at the reference's default batch geometry (batch 128 x
+forward_steps 16, config.yaml:12-18), on the default JAX device (the TPU
+chip under the driver). ``vs_baseline`` is measured-ours / measured-reference:
+the denominator comes from bench_baseline.json, produced by
+scripts/baseline_torch_learner.py — the same step in PyTorch on this host's
+CPU (the reference publishes no numbers of its own; see BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from handyrl_tpu.models import build
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
+    from handyrl_tpu.parallel.mesh import make_mesh, shard_batch
+    from __graft_entry__ import _synthetic_batch
+
+    B, T = 128, 16
+    steps = 30
+
+    module = build('GeeseNet')
+    rng = np.random.RandomState(0)
+    batch = _synthetic_batch(B, T, 1, (17, 7, 11), 4, rng)
+    params = module.init(jax.random.PRNGKey(0), batch['observation'][:, 0, 0], None)
+    state = init_train_state(params)
+
+    cfg = LossConfig(turn_based_training=False, observation=True,
+                     policy_target='TD', value_target='TD', gamma=0.99)
+    devices = jax.devices()
+    mesh = make_mesh(devices) if len(devices) > 1 else None
+    step = build_update_step(module, cfg, mesh=mesh, donate=False)
+    if mesh is not None:
+        batch = shard_batch(mesh, batch)
+    lr = jnp.asarray(1e-5, jnp.float32)
+
+    # warmup/compile
+    for _ in range(3):
+        state, metrics = step(state, batch, lr)
+    jax.block_until_ready(metrics['total'])
+
+    t0 = time.time()
+    for _ in range(steps):
+        state, metrics = step(state, batch, lr)
+    jax.block_until_ready(metrics['total'])
+    dt = time.time() - t0
+    traj_per_sec = B * steps / dt
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'bench_baseline.json')
+    vs_baseline = 0.0
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+        ref = base.get('torch_cpu_trajectories_per_sec', 0.0)
+        if ref > 0:
+            vs_baseline = traj_per_sec / ref
+
+    print(json.dumps({
+        'metric': 'learner trajectories/sec (GeeseNet B=128 T=16, full update step)',
+        'value': round(traj_per_sec, 2),
+        'unit': 'trajectories/sec',
+        'vs_baseline': round(vs_baseline, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()

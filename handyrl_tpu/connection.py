@@ -29,6 +29,24 @@ def send_recv(conn, data):
     return conn.recv()
 
 
+def force_cpu_backend():
+    """Pin this (sub)process's JAX to the CPU backend.
+
+    Worker/eval processes must never claim the TPU: the learner holds the
+    single device, and the TPU plugin blocks a second client forever. Called
+    at the top of every child-process entry point. The explicit config
+    update is required because the axon site hook overrides JAX_PLATFORMS at
+    import time.
+    """
+    import os
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
 class FramedConnection:
     """Length-framed messages over a stream socket."""
 
@@ -99,12 +117,18 @@ def accept_socket_connections(port: int, timeout: Optional[float] = None,
 
 def open_multiprocessing_connections(num_process: int, target: Callable,
                                      args_func: Callable) -> List:
-    """Fork ``num_process`` workers, each holding one end of an mp.Pipe;
-    returns the parent-side ends."""
+    """Start ``num_process`` workers, each holding one end of an mp.Pipe;
+    returns the parent-side ends.
+
+    Uses the 'spawn' context: a forked child would inherit the parent's
+    initialized JAX backend (possibly the exclusive TPU client); a spawned
+    child starts clean and pins itself to CPU via force_cpu_backend().
+    """
+    ctx = mp.get_context('spawn')
     parent_conns = []
     for i in range(num_process):
-        conn0, conn1 = mp.Pipe(duplex=True)
-        mp.Process(target=target, args=args_func(i, conn1), daemon=True).start()
+        conn0, conn1 = ctx.Pipe(duplex=True)
+        ctx.Process(target=target, args=args_func(i, conn1)).start()
         conn1.close()
         parent_conns.append(conn0)
     return parent_conns

@@ -196,6 +196,8 @@ def wp_func(results: Dict[Optional[float], int]) -> float:
 
 def eval_process_mp_child(agents, critic, env_args, index, in_queue, out_queue,
                           seed, show=False):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     random.seed(seed + index)
     env = make_env({**env_args, 'id': index})
     while True:
@@ -319,6 +321,8 @@ def _resolve_agent(model_path: str, env):
 
 
 def eval_main(args, argv):
+    from .connection import force_cpu_backend
+    force_cpu_backend()   # evaluation is a host-side workload
     env_args = args['env_args']
     prepare_env(env_args)
     env = make_env(env_args)
@@ -342,6 +346,8 @@ def eval_main(args, argv):
 
 
 def eval_server_main(args, argv):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     print('network match server mode')
     env_args = args['env_args']
     prepare_env(env_args)
@@ -359,6 +365,8 @@ def eval_server_main(args, argv):
 
 
 def client_mp_child(env_args, model_path, conn):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     env = make_env(env_args)
     agent = build_agent(model_path, env)
     if agent is None:
@@ -367,6 +375,8 @@ def client_mp_child(env_args, model_path, conn):
 
 
 def eval_client_main(args, argv):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     print('network match client mode')
     while True:
         try:

@@ -64,17 +64,21 @@ class Batcher:
         self.episodes = episodes
         self.output_queue: queue.Queue = queue.Queue(maxsize=8)
         self._started = False
+        self.stop_flag = False
+        self._threads: List[threading.Thread] = []
 
     def run(self):
         if self._started:
             return
         self._started = True
         for i in range(self.args['num_batchers']):
-            threading.Thread(target=self._worker, args=(i,), daemon=True).start()
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def _worker(self, bid: int):
         print('started batcher %d' % bid)
-        while True:
+        while not self.stop_flag:
             try:
                 selected = [select_episode(self.episodes, self.args)
                             for _ in range(self.args['batch_size'])]
@@ -82,10 +86,20 @@ class Batcher:
             except (IndexError, ValueError):
                 time.sleep(0.1)
                 continue
-            self.output_queue.put(batch)
+            while not self.stop_flag:
+                try:
+                    self.output_queue.put(batch, timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
 
-    def batch(self):
-        return self.output_queue.get()
+    def batch(self, timeout: Optional[float] = None):
+        return self.output_queue.get(timeout=timeout)
+
+    def stop(self):
+        self.stop_flag = True
+        for t in self._threads:
+            t.join(timeout=5)
 
 
 class Trainer:
@@ -111,6 +125,7 @@ class Trainer:
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
+        self.shutdown_flag = False
 
     def _lr(self) -> float:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
@@ -130,8 +145,11 @@ class Trainer:
         batch_cnt, data_cnt = 0, 0
         pending_metrics: List[Dict[str, jnp.ndarray]] = []
 
-        while data_cnt == 0 or not self.update_flag:
-            batch = self.batcher.batch()
+        while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
+            try:
+                batch = self.batcher.batch(timeout=1.0)
+            except queue.Empty:
+                continue
             if self.mesh is not None:
                 batch = shard_batch(self.mesh, batch)
             lr = jnp.asarray(self._lr(), jnp.float32)
@@ -168,15 +186,25 @@ class Trainer:
 
     def run(self):
         print('waiting training')
-        while len(self.episodes) < self.args['minimum_episodes']:
+        while (len(self.episodes) < self.args['minimum_episodes']
+               and not self.shutdown_flag):
             time.sleep(1)
-        if self.state is not None:
+        if self.state is not None and not self.shutdown_flag:
             self.batcher.run()
             print('started training')
-        while True:
+        while not self.shutdown_flag:
             params = self.train()
             self.update_flag = False
-            self.update_queue.put((params, self.steps))
+            while not self.shutdown_flag:
+                try:
+                    self.update_queue.put((params, self.steps), timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+
+    def shutdown(self):
+        self.shutdown_flag = True
+        self.batcher.stop()
 
 
 class Learner:
@@ -225,6 +253,7 @@ class Learner:
             self.worker = WorkerServer(args) if remote else WorkerCluster(args)
 
         self.trainer = Trainer(args, self.wrapper)
+        self._trainer_thread: Optional[threading.Thread] = None
 
         self._metrics_path = args.get('metrics_jsonl') or ''
 
@@ -474,13 +503,26 @@ class Learner:
                     self.shutdown_flag = True
         print('finished server')
 
+    def shutdown(self):
+        """Stop the trainer loop and join its thread so no daemon thread is
+        left inside XLA at interpreter exit (which aborts the process)."""
+        self.shutdown_flag = True
+        self.trainer.shutdown()
+        if self._trainer_thread is not None:
+            self._trainer_thread.join(timeout=10)
+
     def run(self):
-        threading.Thread(target=self.trainer.run, daemon=True).start()
-        if self.use_batched_generation:
-            self._run_batched()
-        else:
-            self.worker.run()
-            self.server()
+        self._trainer_thread = threading.Thread(target=self.trainer.run,
+                                                daemon=True)
+        self._trainer_thread.start()
+        try:
+            if self.use_batched_generation:
+                self._run_batched()
+            else:
+                self.worker.run()
+                self.server()
+        finally:
+            self.shutdown()
 
 
 def train_main(args):

@@ -98,6 +98,8 @@ def _worker_args(args, n_gathers, gather_id, base_wid, wid, conn):
 
 
 def open_worker(args, conn, wid):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     worker = Worker(args, conn, wid)
     worker.run()
 
@@ -167,6 +169,8 @@ class Gather(QueueCommunicator):
 
 
 def gather_loop(args, conn, gather_id):
+    from .connection import force_cpu_backend
+    force_cpu_backend()
     gather = Gather(args, conn, gather_id)
     gather.run()
 
@@ -186,10 +190,10 @@ class WorkerCluster(QueueCommunicator):
         if 'num_gathers' not in self.args['worker']:
             self.args['worker']['num_gathers'] = \
                 default_num_gathers(self.args['worker']['num_parallel'])
+        ctx = mp.get_context('spawn')   # never fork a TPU-holding learner
         for i in range(self.args['worker']['num_gathers']):
-            conn0, conn1 = mp.Pipe(duplex=True)
-            mp.Process(target=gather_loop, args=(self.args, conn1, i),
-                       daemon=True).start()
+            conn0, conn1 = ctx.Pipe(duplex=True)
+            ctx.Process(target=gather_loop, args=(self.args, conn1, i)).start()
             conn1.close()
             self.add_connection(conn0)
 
@@ -256,11 +260,12 @@ class RemoteWorkerCluster:
         prepare_env(args['env'])
 
         processes = []
+        ctx = mp.get_context('spawn')
         try:
             for i in range(self.args['num_gathers']):
                 conn = connect_socket_connection(self.args['server_address'],
                                                  WorkerServer.WORKER_PORT)
-                p = mp.Process(target=gather_loop, args=(args, conn, i))
+                p = ctx.Process(target=gather_loop, args=(args, conn, i))
                 p.start()
                 conn.close()
                 processes.append(p)
@@ -272,6 +277,8 @@ class RemoteWorkerCluster:
 
 
 def worker_main(args, argv):
+    from .connection import force_cpu_backend
+    force_cpu_backend()   # worker hosts are CPU actors by design
     worker_args = args['worker_args']
     if len(argv) >= 1:
         worker_args['num_parallel'] = int(argv[0])

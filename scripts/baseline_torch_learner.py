@@ -1,0 +1,122 @@
+"""Measure the reference-style PyTorch learner step on this host (CPU).
+
+The reference (DeNA/HandyRL) publishes no benchmark numbers (BASELINE.md), so
+the baseline is measured: a faithful PyTorch GeeseNet (12 torus-conv residual
+blocks, reference hungry_geese.py:38-57) doing the reference's training step
+— forward over a (B,T,P) window batch, TD(lambda) targets, policy-gradient +
+value losses, backward, clipped Adam step — at the reference's default batch
+geometry. Writes trajectories/sec to bench_baseline.json, which bench.py
+uses as the vs_baseline denominator.
+
+Run: python scripts/baseline_torch_learner.py [batch_size] [steps]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class TorusConv(nn.Module):
+    def __init__(self, cin, cout, ksize=3, bn=True):
+        super().__init__()
+        self.pad = ksize // 2
+        self.conv = nn.Conv2d(cin, cout, ksize)
+        self.bn = nn.BatchNorm2d(cout) if bn else None
+
+    def forward(self, x):
+        h = torch.cat([x[..., -self.pad:], x, x[..., :self.pad]], dim=3)
+        h = torch.cat([h[..., -self.pad:, :], h, h[..., :self.pad, :]], dim=2)
+        h = self.conv(h)
+        return self.bn(h) if self.bn is not None else h
+
+
+class GeeseNetTorch(nn.Module):
+    def __init__(self, layers=12, filters=32):
+        super().__init__()
+        self.conv0 = TorusConv(17, filters)
+        self.blocks = nn.ModuleList([TorusConv(filters, filters) for _ in range(layers)])
+        self.head_p = nn.Linear(filters, 4, bias=False)
+        self.head_v = nn.Linear(filters * 2, 1, bias=False)
+
+    def forward(self, x):
+        h = F.relu(self.conv0(x))
+        for b in self.blocks:
+            h = F.relu(h + b(h))
+        head = (h * x[:, :1]).flatten(2).sum(-1)
+        avg = h.flatten(2).mean(-1)
+        p = self.head_p(head)
+        v = torch.tanh(self.head_v(torch.cat([head, avg], 1)))
+        return p, v
+
+
+def td_lambda_torch(values, returns_last, rewards, lmb, gamma):
+    T = values.shape[1]
+    tv = [None] * T
+    tv[T - 1] = returns_last
+    for t in range(T - 2, -1, -1):
+        tv[t] = rewards[:, t] + gamma * ((1 - lmb) * values[:, t + 1] + lmb * tv[t + 1])
+    return torch.stack(tv, dim=1)
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    T = 16
+    torch.manual_seed(0)
+    rng = np.random.RandomState(0)
+
+    model = GeeseNetTorch()
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-5, weight_decay=1e-5)
+
+    obs = torch.from_numpy(rng.rand(B, T, 17, 7, 11).astype(np.float32))
+    actions = torch.from_numpy(rng.randint(0, 4, (B, T, 1)).astype(np.int64))
+    b_prob = torch.full((B, T, 1), 0.25)
+    outcome = torch.from_numpy(np.sign(rng.randn(B, 1, 1)).astype(np.float32))
+    rewards = torch.zeros(B, T, 1)
+
+    def one_step():
+        p, v = model(obs.flatten(0, 1))
+        p = p.unflatten(0, (B, T))
+        v = v.unflatten(0, (B, T))
+        logp = F.log_softmax(p, -1).gather(-1, actions)
+        with torch.no_grad():
+            rho = torch.clamp((logp.detach() - b_prob.log()).exp(), 0, 1)
+            targets = td_lambda_torch(v.detach(), outcome[:, 0], rewards, 0.7, 1.0)
+            adv = rho * (targets - v.detach())
+        loss = (-logp * adv).sum() + ((v - targets) ** 2).sum() / 2
+        opt.zero_grad()
+        loss.backward()
+        nn.utils.clip_grad_norm_(model.parameters(), 4.0)
+        opt.step()
+
+    for _ in range(3):
+        one_step()
+    t0 = time.time()
+    for _ in range(steps):
+        one_step()
+    dt = time.time() - t0
+    traj_per_sec = B * steps / dt
+
+    out = {
+        'torch_cpu_trajectories_per_sec': traj_per_sec,
+        'batch_size': B, 'forward_steps': T,
+        'model': 'GeeseNet(12x32 torus-conv)',
+        'device': 'cpu', 'torch_version': torch.__version__,
+        'note': 'reference-style learner step measured on this host; '
+                'see scripts/baseline_torch_learner.py',
+    }
+    path = os.path.join(os.path.dirname(__file__), '..', 'bench_baseline.json')
+    with open(os.path.abspath(path), 'w') as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == '__main__':
+    main()

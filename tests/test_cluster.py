@@ -1,0 +1,31 @@
+"""Fake-cluster integration test: the 4-RPC worker protocol end to end.
+
+Runs the Learner in worker-process mode (batched_generation off): learner
+server -> gather processes -> worker processes over spawn+pipes, one training
+epoch, model snapshots fetched over the wire.
+"""
+
+import pytest
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.train import Learner
+
+
+@pytest.mark.timeout(600)
+def test_local_worker_cluster_one_epoch(tmp_path):
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 8, 'update_episodes': 20, 'minimum_episodes': 20,
+            'epochs': 1, 'forward_steps': 8, 'num_batchers': 1,
+            'batched_generation': False,
+            'worker': {'num_parallel': 2},
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    args = apply_defaults(raw)
+    learner = Learner(args=args)
+    learner.run()
+    assert learner.model_epoch == 1
+    assert learner.num_returned_episodes >= 20
+    assert (tmp_path / 'models' / '1.ckpt').exists()
