@@ -1,0 +1,290 @@
+"""Hungry Geese: 4-player simultaneous survival game on a 7x11 torus.
+
+The reference wraps ``kaggle_environments.make("hungry_geese")``
+(hungry_geese.py:60-231); that package is not available here, so this module
+implements the game natively with the same rules and the same training
+surface:
+
+  * geese move N/S/W/E each step on a wrapping 7x11 grid; reversing onto
+    your own neck is death; eating food grows the goose; every 40 steps
+    every goose loses a tail cell (starvation at length 0); colliding with
+    any goose body, or head-to-head, is death; the game ends when at most
+    one goose survives or after 200 steps;
+  * per-goose score = survival steps dominating, then length (the kaggle
+    reward formula's ordering), and the outcome is the pairwise-rank score
+    in {-1, -1/3, +1/3, +1} exactly as the reference computes it
+    (hungry_geese.py:168-180);
+  * observations are the same 17x7x11 planes (heads, tails, bodies,
+    previous heads — all rotated so the observing player is channel 0 — and
+    food), built from the last two board states (hungry_geese.py:202-231);
+  * ``rule_based_action`` is a greedy food-seeker that avoids immediate
+    death (the reference delegates to kaggle's GreedyAgent).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...environment import BaseEnvironment
+
+R, C = 7, 11
+N_CELLS = R * C
+ACTIONS = ['NORTH', 'SOUTH', 'WEST', 'EAST']
+DELTAS = [(-1, 0), (1, 0), (0, -1), (0, 1)]
+OPPOSITE = {0: 1, 1: 0, 2: 3, 3: 2}
+HUNGER_RATE = 40
+MAX_STEPS = 200
+N_FOOD = 2
+MAX_LEN_SCORE = N_CELLS + 1     # score base so survival dominates length
+
+
+def _move(cell: int, action: int) -> int:
+    x, y = divmod(cell, C)
+    dx, dy = DELTAS[action]
+    return ((x + dx) % R) * C + (y + dy) % C
+
+
+class Environment(BaseEnvironment):
+    NUM_AGENTS = 4
+
+    def __init__(self, args: Optional[dict] = None):
+        super().__init__(args)
+        args = args or {}
+        self.rng = random.Random(args.get('id', 0))
+        self.reset()
+
+    def reset(self, args: Optional[dict] = None):
+        cells = self.rng.sample(range(N_CELLS), self.NUM_AGENTS + N_FOOD)
+        self.geese: List[List[int]] = [[c] for c in cells[:self.NUM_AGENTS]]
+        self.food: List[int] = cells[self.NUM_AGENTS:]
+        self.alive: List[bool] = [True] * self.NUM_AGENTS
+        self.scores: List[float] = [0.0] * self.NUM_AGENTS
+        self.last_actions: Dict[int, int] = {}
+        self.prev_geese: List[List[int]] = [list(g) for g in self.geese]
+        self.step_count = 0
+        self._update_scores()
+
+    # -- helpers -----------------------------------------------------------
+    def _update_scores(self):
+        for p in range(self.NUM_AGENTS):
+            if self.alive[p]:
+                self.scores[p] = ((self.step_count + 1) * MAX_LEN_SCORE
+                                  + len(self.geese[p]))
+
+    def _spawn_food(self):
+        occupied = set(self.food)
+        for g in self.geese:
+            occupied.update(g)
+        free = [c for c in range(N_CELLS) if c not in occupied]
+        while len(self.food) < N_FOOD and free:
+            cell = self.rng.choice(free)
+            free.remove(cell)
+            self.food.append(cell)
+
+    # -- transitions -------------------------------------------------------
+    def step(self, actions: Dict[int, Optional[int]]):
+        self.prev_geese = [list(g) for g in self.geese]
+        self.step_count += 1
+        acted: Dict[int, int] = {}
+
+        # move phase
+        for p in range(self.NUM_AGENTS):
+            if not self.alive[p]:
+                continue
+            action = actions.get(p)
+            action = 0 if action is None else int(action)
+            acted[p] = action
+            goose = self.geese[p]
+            if (p in self.last_actions
+                    and action == OPPOSITE[self.last_actions[p]]
+                    and len(goose) > 1):
+                self.alive[p] = False      # reversed onto its own neck
+                self.geese[p] = []
+                continue
+            head = _move(goose[0], action)
+            goose.insert(0, head)
+            if head in self.food:
+                self.food.remove(head)     # grow: keep the tail
+            else:
+                goose.pop()
+
+        # starvation phase
+        if self.step_count % HUNGER_RATE == 0:
+            for p in range(self.NUM_AGENTS):
+                if self.alive[p] and self.geese[p]:
+                    self.geese[p].pop()
+                    if not self.geese[p]:
+                        self.alive[p] = False
+
+        # collision phase (simultaneous: evaluated on the post-move board)
+        head_count: Dict[int, int] = {}
+        bodies = set()
+        for p in range(self.NUM_AGENTS):
+            if not self.alive[p] or not self.geese[p]:
+                continue
+            head_count[self.geese[p][0]] = head_count.get(self.geese[p][0], 0) + 1
+            bodies.update(self.geese[p][1:])
+        for p in range(self.NUM_AGENTS):
+            if not self.alive[p] or not self.geese[p]:
+                continue
+            head = self.geese[p][0]
+            if head in bodies or head_count[head] > 1:
+                self.alive[p] = False
+                self.geese[p] = []
+
+        for p, a in acted.items():
+            self.last_actions[p] = a
+        self._spawn_food()
+        self._update_scores()
+
+    # -- protocol ----------------------------------------------------------
+    def turns(self) -> List[int]:
+        return [p for p in self.players() if self.alive[p]]
+
+    def terminal(self) -> bool:
+        return sum(self.alive) <= 1 or self.step_count >= MAX_STEPS
+
+    def outcome(self) -> Dict[int, float]:
+        """Pairwise-rank score: +1/(N-1) per beaten opponent, -1/(N-1) per
+        opponent that beat you."""
+        outcomes = {p: 0.0 for p in self.players()}
+        for p in self.players():
+            for q in self.players():
+                if p == q:
+                    continue
+                if self.scores[p] > self.scores[q]:
+                    outcomes[p] += 1 / (self.NUM_AGENTS - 1)
+                elif self.scores[p] < self.scores[q]:
+                    outcomes[p] -= 1 / (self.NUM_AGENTS - 1)
+        return outcomes
+
+    def legal_actions(self, player: Optional[int] = None) -> List[int]:
+        return list(range(len(ACTIONS)))
+
+    def players(self) -> List[int]:
+        return list(range(self.NUM_AGENTS))
+
+    def action2str(self, a: int, player: Optional[int] = None) -> str:
+        return ACTIONS[a]
+
+    def str2action(self, s: str, player: Optional[int] = None) -> int:
+        return ACTIONS.index(s)
+
+    # -- delta sync --------------------------------------------------------
+    def diff_info(self, player: Optional[int] = None):
+        return {
+            'geese': [list(g) for g in self.geese],
+            'prev_geese': [list(g) for g in self.prev_geese],
+            'food': list(self.food),
+            'alive': list(self.alive),
+            'scores': list(self.scores),
+            'last_actions': dict(self.last_actions),
+            'step': self.step_count,
+        }
+
+    def update(self, info, reset: bool):
+        self.geese = [list(g) for g in info['geese']]
+        self.prev_geese = [list(g) for g in info['prev_geese']]
+        self.food = list(info['food'])
+        self.alive = list(info['alive'])
+        self.scores = list(info['scores'])
+        self.last_actions = dict(info['last_actions'])
+        self.step_count = info['step']
+
+    # -- observation -------------------------------------------------------
+    def observation(self, player: Optional[int] = None) -> np.ndarray:
+        if player is None:
+            player = 0
+        b = np.zeros((self.NUM_AGENTS * 4 + 1, N_CELLS), dtype=np.float32)
+        for p, goose in enumerate(self.geese):
+            ch = (p - player) % self.NUM_AGENTS
+            for cell in goose[:1]:
+                b[0 + ch, cell] = 1
+            for cell in goose[-1:]:
+                b[4 + ch, cell] = 1
+            for cell in goose:
+                b[8 + ch, cell] = 1
+        for p, goose in enumerate(self.prev_geese):
+            ch = (p - player) % self.NUM_AGENTS
+            for cell in goose[:1]:
+                b[12 + ch, cell] = 1
+        for cell in self.food:
+            b[16, cell] = 1
+        return b.reshape(-1, R, C)
+
+    # -- rule-based opponent ----------------------------------------------
+    def rule_based_action(self, player: int, key=None) -> int:
+        """Greedy: head toward the nearest food, never reverse, avoid cells
+        that are currently occupied or contested by an adjacent head."""
+        goose = self.geese[player]
+        if not goose:
+            return 0
+        head = goose[0]
+        hx, hy = divmod(head, C)
+
+        occupied = set()
+        danger = set()
+        for p, g in enumerate(self.geese):
+            if not g:
+                continue
+            occupied.update(g[:-1] if len(g) > 1 else g)  # tail will move on
+            if p != player:
+                for a in range(4):
+                    danger.add(_move(g[0], a))
+
+        banned = None
+        if player in self.last_actions:
+            banned = OPPOSITE[self.last_actions[player]]
+
+        def torus_dist(a, b):
+            ax, ay = divmod(a, C)
+            bx, by = divmod(b, C)
+            dx = min((ax - bx) % R, (bx - ax) % R)
+            dy = min((ay - by) % C, (by - ay) % C)
+            return dx + dy
+
+        candidates = []
+        for a in range(4):
+            if a == banned:
+                continue
+            to = _move(head, a)
+            if to in occupied:
+                continue
+            risk = 1 if to in danger else 0
+            dist = min((torus_dist(to, f) for f in self.food), default=0)
+            candidates.append((risk, dist, a))
+        if not candidates:
+            return banned == 0 and 1 or 0
+        candidates.sort()
+        return candidates[0][2]
+
+    def net(self):
+        from ...models.geese import GeeseNet
+        return GeeseNet()
+
+    def __str__(self) -> str:
+        grid = [['.'] * C for _ in range(R)]
+        for cell in self.food:
+            x, y = divmod(cell, C)
+            grid[x][y] = 'f'
+        for p, goose in enumerate(self.geese):
+            for i, cell in enumerate(goose):
+                x, y = divmod(cell, C)
+                grid[x][y] = str(p) if i == 0 else 'abcd'[p]
+        lines = ['step %d  alive %s' % (self.step_count, self.alive)]
+        lines += [''.join(row) for row in grid]
+        lines.append(' '.join(str(len(g) or '-') for g in self.geese))
+        return '\n'.join(lines)
+
+
+if __name__ == '__main__':
+    e = Environment()
+    for _ in range(10):
+        e.reset()
+        while not e.terminal():
+            e.step({p: random.choice(e.legal_actions(p)) for p in e.turns()})
+        print(e)
+        print(e.outcome())
