@@ -302,8 +302,63 @@ def network_match_acception(n: int, env_args, num_agents: int, port: int):
              for j in range(num_agents)] for i in range(n)]
 
 
+class ExportedModel:
+    """Inference over a serialized-StableHLO export (scripts/export_model.py).
+
+    Counterpart of the reference's OnnxModel (evaluation.py:288-354): same
+    numpy-in/numpy-out ``inference``/``init_hidden`` surface, loadable
+    without the model's Python class. Hidden-state shapes are recovered from
+    the export's input signature."""
+
+    def __init__(self, model_path: str):
+        self.model_path = model_path
+        self._exported = None
+        self._hidden_spec = None
+
+    def _open(self):
+        if self._exported is not None:
+            return
+        import jax
+        from jax import export as jexport
+        from jax import tree_util
+        with open(self.model_path, 'rb') as f:
+            self._exported = jexport.deserialize(f.read())
+        args, _kwargs = tree_util.tree_unflatten(
+            self._exported.in_tree, list(self._exported.in_avals))
+        self._hidden_spec = args[1] if len(args) > 1 else None
+
+    def init_hidden(self, batch_size=None):
+        import numpy as np
+        from jax import tree_util
+        self._open()
+        if self._hidden_spec is None:
+            return None
+        return tree_util.tree_map(
+            lambda a: np.zeros(a.shape, a.dtype), self._hidden_spec)
+
+    def inference(self, x, hidden=None):
+        import numpy as np
+        from .utils.tree import map_structure
+        self._open()
+        obs = map_structure(lambda v: np.asarray(v, np.float32)[None], x)
+        if self._hidden_spec is not None:
+            outputs = self._exported.call(obs, hidden)
+        else:
+            outputs = self._exported.call(obs)
+        result = {}
+        for k, v in outputs.items():
+            if k == 'hidden':
+                result[k] = v
+            elif v is not None:
+                result[k] = np.asarray(v)[0]
+        return result
+
+
 def load_model(model_path: str, env):
-    """Load a checkpoint produced by the learner (msgpack params + arch)."""
+    """Load a model file: .jaxexp exports (self-contained StableHLO) or
+    learner checkpoints (msgpack params + the env's architecture)."""
+    if model_path.endswith('.jaxexp'):
+        return ExportedModel(model_path)
     from .model import ModelWrapper
     wrapper = ModelWrapper(env.net())
     env.reset()

@@ -1,0 +1,70 @@
+"""Stochastic Weight Averaging over a range of epoch checkpoints.
+
+Counterpart of the reference's SWA script (scripts/aux_swa.py): equal-weight
+running average of ``models/<epoch>.ckpt`` params (a plain pytree mean — no
+torch AveragedModel machinery needed), written to ``models/swa.ckpt`` and
+verified by strict reload + inference.
+
+Usage: python scripts/aux_swa.py ENV START END [MODEL_DIR]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+
+def main():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.model import ModelWrapper
+
+    env_name = sys.argv[1] if len(sys.argv) > 1 else 'TicTacToe'
+    start = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    end = int(sys.argv[3]) if len(sys.argv) > 3 else start
+    model_dir = sys.argv[4] if len(sys.argv) > 4 else 'models'
+
+    env = make_env({'env': env_name})
+    env.reset()
+    example_obs = env.observation(env.players()[0])
+
+    avg = None
+    count = 0
+    wrapper = ModelWrapper(env.net())
+    for epoch in range(start, end + 1):
+        path = os.path.join(model_dir, '%d.ckpt' % epoch)
+        if not os.path.exists(path):
+            print('skip missing', path)
+            continue
+        with open(path, 'rb') as f:
+            wrapper.load_params_bytes(f.read(), example_obs)
+        count += 1
+        if avg is None:
+            avg = jax.tree_util.tree_map(jnp.asarray, wrapper.params)
+        else:
+            # running equal-weight mean: avg += (x - avg) / n
+            avg = jax.tree_util.tree_map(
+                lambda a, x: a + (x - a) / count, avg, wrapper.params)
+    assert avg is not None, 'no checkpoints found in range'
+    print('averaged %d checkpoints' % count)
+
+    wrapper.params = avg
+    out_path = os.path.join(model_dir, 'swa.ckpt')
+    with open(out_path, 'wb') as f:
+        f.write(wrapper.params_bytes())
+    print('wrote', out_path)
+
+    # strict reload + probe inference as a self-test
+    check = ModelWrapper(env.net())
+    with open(out_path, 'rb') as f:
+        check.load_params_bytes(f.read(), example_obs)
+    out = check.inference(example_obs, check.init_hidden())
+    assert 'policy' in out
+    print('reload check ok; policy shape', out['policy'].shape)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,64 @@
+"""Export a trained model to a portable serialized-StableHLO artifact.
+
+Counterpart of the reference's ONNX export (scripts/make_onnx_model.py):
+onnxruntime is not part of this stack, so the export format is
+``jax.export`` StableHLO with params baked in — loadable by any JAX install
+with no handyrl_tpu code (see handyrl_tpu.evaluation.ExportedModel, the
+OnnxModel counterpart). Hidden-state inputs/outputs are preserved for
+recurrent nets.
+
+Usage: python scripts/export_model.py ENV CKPT_PATH OUT_PATH [BATCH]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+
+def main():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import load_model
+    from handyrl_tpu.utils.tree import map_structure
+
+    env_name = sys.argv[1] if len(sys.argv) > 1 else 'TicTacToe'
+    ckpt = sys.argv[2] if len(sys.argv) > 2 else 'models/latest.ckpt'
+    out_path = sys.argv[3] if len(sys.argv) > 3 else 'models/latest.jaxexp'
+
+    env = make_env({'env': env_name})
+    env.reset()
+    example_obs = env.observation(env.players()[0])
+    wrapper = load_model(ckpt, env)
+    params = wrapper.params
+    hidden = wrapper.init_hidden((1,))
+
+    def infer(obs, hidden):
+        return wrapper.module.apply(params, obs, hidden)
+
+    obs_spec = map_structure(
+        lambda v: jax.ShapeDtypeStruct((1,) + v.shape, jnp.float32), example_obs)
+    if hidden is None:
+        exported = jexport.export(jax.jit(lambda obs: infer(obs, None)))(obs_spec)
+    else:
+        hidden_spec = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), hidden)
+        exported = jexport.export(jax.jit(infer))(obs_spec, hidden_spec)
+
+    with open(out_path, 'wb') as f:
+        f.write(exported.serialize())
+    print('wrote', out_path, os.path.getsize(out_path), 'bytes')
+
+    # self-test: reload and run
+    from handyrl_tpu.evaluation import ExportedModel
+    m = ExportedModel(out_path)
+    out = m.inference(example_obs, m.init_hidden())
+    print('reload check ok; outputs', sorted(out.keys()))
+
+
+if __name__ == '__main__':
+    main()

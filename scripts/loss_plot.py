@@ -1,0 +1,55 @@
+"""Plot per-term loss curves from a training stdout log.
+
+Parses ``loss = p:... v:... ent:... total:...`` lines (one per epoch).
+
+Usage: python scripts/loss_plot.py LOG_FILE [OUT.png]
+"""
+
+import re
+import sys
+
+LOSS_RE = re.compile(r'^loss = (.+)$')
+TERM_RE = re.compile(r'(\w+):(-?[\d.]+(?:e-?\d+)?)')
+
+
+def parse(path):
+    series = {}
+    with open(path) as f:
+        for line in f:
+            m = LOSS_RE.match(line)
+            if not m:
+                continue
+            for term, value in TERM_RE.findall(m.group(1)):
+                series.setdefault(term, []).append(float(value))
+    return series
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else 'train.log'
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    series = parse(path)
+    if not series:
+        print('no loss lines found in', path)
+        return
+    for term, values in series.items():
+        print('%s: %d points, last = %.4f' % (term, len(values), values[-1]))
+    try:
+        import matplotlib
+        matplotlib.use('Agg')
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print('matplotlib not available; printed summary only')
+        return
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for term, values in sorted(series.items()):
+        ax.plot(values, label=term)
+    ax.set_xlabel('epoch')
+    ax.set_ylabel('loss (per-sample)')
+    ax.legend()
+    out = out or path + '.loss.png'
+    fig.savefig(out, dpi=120, bbox_inches='tight')
+    print('wrote', out)
+
+
+if __name__ == '__main__':
+    main()

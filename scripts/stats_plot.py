@@ -1,0 +1,55 @@
+"""Plot generation-outcome statistics from a training stdout log.
+
+Parses ``generation stats = MEAN +- STD`` lines (one per epoch).
+
+Usage: python scripts/stats_plot.py LOG_FILE [OUT.png]
+"""
+
+import re
+import sys
+
+STATS_RE = re.compile(r'^generation stats = (-?[\d.]+) \+- ([\d.]+)')
+
+
+def parse(path):
+    rows = []
+    with open(path) as f:
+        for line in f:
+            m = STATS_RE.match(line)
+            if m:
+                rows.append((float(m.group(1)), float(m.group(2))))
+    return rows
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else 'train.log'
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    rows = parse(path)
+    if not rows:
+        print('no generation-stats lines found in', path)
+        return
+    print('%d points, last mean=%.3f std=%.3f' % (len(rows), *rows[-1]))
+    try:
+        import matplotlib
+        matplotlib.use('Agg')
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print('matplotlib not available; printed summary only')
+        return
+    means = [r[0] for r in rows]
+    stds = [r[1] for r in rows]
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.plot(means, label='mean outcome')
+    ax.fill_between(range(len(rows)),
+                    [m - s for m, s in rows], [m + s for m, s in rows],
+                    alpha=0.2)
+    ax.set_xlabel('epoch')
+    ax.set_ylabel('self-play outcome')
+    ax.legend()
+    out = out or path + '.stats.png'
+    fig.savefig(out, dpi=120, bbox_inches='tight')
+    print('wrote', out)
+
+
+if __name__ == '__main__':
+    main()

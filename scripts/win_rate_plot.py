@@ -1,0 +1,71 @@
+"""Plot win-rate curves from a training stdout log.
+
+The learner's line-oriented log is the metrics interface (SURVEY.md §5.5):
+this parses ``epoch N`` / ``win rate (opp) = X (w / n)`` lines and plots
+win rate per opponent against episode count.
+
+Usage: python scripts/win_rate_plot.py LOG_FILE [OUT.png]
+"""
+
+import re
+import sys
+
+
+EPOCH_RE = re.compile(r'^epoch (\d+)')
+WIN_RE = re.compile(r'^win rate(?: \((.+)\))? = ([\d.]+) \(([\d.-]+) / (\d+)\)')
+UPDATED_RE = re.compile(r'updated model\((\d+)\)')
+
+
+def parse(path):
+    epochs, series = [], {}
+    current_epoch = None
+    with open(path) as f:
+        for line in f:
+            m = EPOCH_RE.match(line)
+            if m:
+                current_epoch = int(m.group(1))
+                epochs.append(current_epoch)
+                continue
+            m = WIN_RE.match(line)
+            if m and current_epoch is not None:
+                opponent = m.group(1) or 'total'
+                series.setdefault(opponent, []).append(
+                    (current_epoch, float(m.group(2)), int(m.group(4))))
+    return epochs, series
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else 'train.log'
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    _, series = parse(path)
+    if not series:
+        print('no win-rate lines found in', path)
+        return
+    for opponent, rows in series.items():
+        tail = rows[-1]
+        print('%s: %d points, last = %.3f (epoch %d, n=%d)'
+              % (opponent, len(rows), tail[1], tail[0], tail[2]))
+    try:
+        import matplotlib
+        matplotlib.use('Agg')
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print('matplotlib not available; printed summary only')
+        return
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for opponent, rows in sorted(series.items()):
+        xs = [r[0] for r in rows]
+        ys = [r[1] for r in rows]
+        ax.plot(xs, ys, label=opponent)
+    ax.set_xlabel('epoch')
+    ax.set_ylabel('win rate')
+    ax.set_ylim(0, 1)
+    ax.axhline(0.5, color='gray', lw=0.5)
+    ax.legend()
+    out = out or path + '.win_rate.png'
+    fig.savefig(out, dpi=120, bbox_inches='tight')
+    print('wrote', out)
+
+
+if __name__ == '__main__':
+    main()

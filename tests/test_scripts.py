@@ -1,0 +1,97 @@
+"""Tooling-script tests: SWA averaging, StableHLO export round-trip, and the
+log-parsing plotters."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), '..', 'scripts')
+sys.path.insert(0, os.path.abspath(SCRIPTS))
+
+
+@pytest.fixture(scope='module')
+def trained_models(tmp_path_factory):
+    """Two real checkpoints from a tiny training run."""
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.train import Learner
+    model_dir = str(tmp_path_factory.mktemp('swa') / 'models')
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 16, 'update_episodes': 25, 'minimum_episodes': 30,
+            'epochs': 2, 'generation_envs': 8, 'forward_steps': 8,
+            'num_batchers': 1, 'model_dir': model_dir,
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    learner.run()
+    return model_dir
+
+
+def test_swa_script(trained_models, monkeypatch):
+    import aux_swa
+    monkeypatch.setattr(sys, 'argv',
+                        ['aux_swa.py', 'TicTacToe', '1', '2', trained_models])
+    aux_swa.main()
+    assert os.path.exists(os.path.join(trained_models, 'swa.ckpt'))
+    # the average must differ from both endpoints
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import load_model
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    obs = env.observation(0)
+    outs = [load_model(os.path.join(trained_models, name), env).inference(obs)['policy']
+            for name in ('1.ckpt', '2.ckpt', 'swa.ckpt')]
+    assert not np.allclose(outs[0], outs[2])
+
+
+def test_export_script(trained_models, monkeypatch, tmp_path):
+    import export_model
+    out = str(tmp_path / 'model.jaxexp')
+    monkeypatch.setattr(sys, 'argv',
+                        ['export_model.py', 'TicTacToe',
+                         os.path.join(trained_models, 'latest.ckpt'), out])
+    export_model.main()
+
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import load_model
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    obs = env.observation(0)
+    native = load_model(os.path.join(trained_models, 'latest.ckpt'), env)
+    exported = load_model(out, env)
+    np.testing.assert_allclose(exported.inference(obs)['policy'],
+                               native.inference(obs)['policy'], atol=1e-4)
+
+
+def test_plot_parsers(tmp_path):
+    log = tmp_path / 'train.log'
+    log.write_text(
+        'waiting training\n'
+        'epoch 1\n'
+        'win rate = 0.500 (10.0 / 20)\n'
+        'generation stats = 0.100 +- 0.900\n'
+        'loss = ent:1.500 p:-0.250 total:0.125 v:0.200\n'
+        'updated model(50)\n'
+        'epoch 2\n'
+        'win rate (random) = 0.650 (13.0 / 20)\n'
+        'win rate (total) = 0.640 (12.8 / 20)\n'
+        'generation stats = 0.150 +- 0.800\n'
+        'loss = ent:1.400 p:-0.200 total:0.100 v:0.150\n'
+    )
+    import loss_plot
+    import stats_plot
+    import win_rate_plot
+
+    _, series = win_rate_plot.parse(str(log))
+    assert series['total'][0][1] == 0.5
+    assert series['random'][0] == (2, 0.65, 20)
+
+    losses = loss_plot.parse(str(log))
+    assert losses['ent'] == [1.5, 1.4]
+    assert losses['p'] == [-0.25, -0.2]
+
+    stats = stats_plot.parse(str(log))
+    assert stats == [(0.1, 0.9), (0.15, 0.8)]
