@@ -130,6 +130,27 @@ class Trainer:
     def _lr(self) -> float:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
 
+    # -- full-state checkpointing (params + optimizer + schedule) ---------
+    # The reference checkpoints the model only (optimizer state and RNG are
+    # lost on resume, docs/parameters.md:76-82); here the whole TrainState
+    # round-trips so restarts continue the same optimization trajectory.
+    def state_bytes(self) -> bytes:
+        from flax import serialization
+        payload = {'state': self.state, 'steps': self.steps,
+                   'data_cnt_ema': self.data_cnt_ema}
+        return serialization.to_bytes(payload)
+
+    def load_state_bytes(self, raw: bytes):
+        from flax import serialization
+        template = {'state': self.state, 'steps': self.steps,
+                    'data_cnt_ema': self.data_cnt_ema}
+        payload = serialization.from_bytes(template, raw)
+        self.state = jax.tree_util.tree_map(jnp.asarray, payload['state'])
+        if isinstance(self.state, tuple):
+            self.state = TrainState(*self.state)
+        self.steps = int(payload['steps'])
+        self.data_cnt_ema = float(payload['data_cnt_ema'])
+
     def update(self):
         """Called by the learner at each epoch boundary; blocks until the
         trainer hands over the new params."""
@@ -232,9 +253,11 @@ class Learner:
         self.env.reset()
         self._example_obs = self.env.observation(self.env.players()[0])
         self.wrapper.ensure_params(self._example_obs)
+        self._resume = False
         if self.model_epoch > 0:
             with open(self.model_path(self.model_epoch), 'rb') as f:
                 self.wrapper.load_params_bytes(f.read(), self._example_obs)
+            self._resume = True
 
         # generation accounting
         self.generation_results: Dict[int, tuple] = {}
@@ -253,6 +276,12 @@ class Learner:
             self.worker = WorkerServer(args) if remote else WorkerCluster(args)
 
         self.trainer = Trainer(args, self.wrapper)
+        if self._resume:
+            state_path = self.trainer_state_path()
+            if os.path.exists(state_path):
+                with open(state_path, 'rb') as f:
+                    self.trainer.load_state_bytes(f.read())
+                print('resumed trainer state (steps %d)' % self.trainer.steps)
         self._trainer_thread: Optional[threading.Thread] = None
 
         self._metrics_path = args.get('metrics_jsonl') or ''
@@ -265,6 +294,10 @@ class Learner:
     def latest_model_path(self) -> str:
         return os.path.join(self.args.get('model_dir', 'models'), 'latest.ckpt')
 
+    def trainer_state_path(self) -> str:
+        return os.path.join(self.args.get('model_dir', 'models'),
+                            'trainer_state.ckpt')
+
     def update_model(self, params, steps: int):
         print('updated model(%d)' % steps)
         self.model_epoch += 1
@@ -274,6 +307,9 @@ class Learner:
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
             with open(path, 'wb') as f:
                 f.write(raw)
+        if self.trainer.state is not None:
+            with open(self.trainer_state_path(), 'wb') as f:
+                f.write(self.trainer.state_bytes())
 
     # -- accounting -------------------------------------------------------
     def feed_episodes(self, episodes: List[Optional[dict]]):
