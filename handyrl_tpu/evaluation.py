@@ -287,17 +287,21 @@ def evaluate_mp(env, agents: List[Any], critic, env_args, args_patterns,
 
 
 def network_match_acception(n: int, env_args, num_agents: int, port: int):
-    """Accept n*num_agents client connections; group into per-match agent
-    lists."""
+    """Accept exactly n*num_agents client connections, grouped per match;
+    every accepted client immediately receives env_args (the reference only
+    answered the first of each group and relied on surplus reconnects)."""
     waiting, accepted = [], []
-    for conn in accept_socket_connections(port):
-        if len(accepted) >= n * num_agents:
-            break
+    acceptor = accept_socket_connections(port)
+    while len(accepted) < n * num_agents:
+        conn = next(acceptor)
+        if conn is None:
+            continue
         waiting.append(conn)
         if len(waiting) == num_agents:
-            conn = waiting.pop(0)
-            accepted.append(conn)
-            conn.send(env_args)
+            for c in waiting:
+                c.send(env_args)
+            accepted += waiting
+            waiting = []
     return [[NetworkAgent(accepted[i * num_agents + j])
              for j in range(num_agents)] for i in range(n)]
 

@@ -1,0 +1,105 @@
+"""Socket-layer tests: framed transport, worker-server entry handshake, and
+a full network battle (server-side env vs remote agents over TCP)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from handyrl_tpu.agent import RandomAgent
+from handyrl_tpu.connection import (FramedConnection, accept_socket_connections,
+                                    connect_socket_connection)
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.evaluation import (NetworkAgent, NetworkAgentClient,
+                                    exec_network_match, network_match_acception)
+from handyrl_tpu.worker import WorkerServer, entry
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(('', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_framed_connection_roundtrip():
+    port = _free_port()
+    results = {}
+
+    def server():
+        gen = accept_socket_connections(port=port, maxsize=1)
+        conn = next(gen)
+        results['got'] = conn.recv()
+        conn.send({'pong': [1, 2, 3]})
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    conn = connect_socket_connection('localhost', port)
+    import numpy as np
+    payload = {'ping': np.arange(5), 'big': b'x' * 100000}
+    conn.send(payload)
+    reply = conn.recv()
+    t.join(timeout=5)
+    assert reply == {'pong': [1, 2, 3]}
+    assert list(results['got']['ping']) == [0, 1, 2, 3, 4]
+    assert len(results['got']['big']) == 100000
+
+
+def test_worker_server_entry_handshake(monkeypatch):
+    port = _free_port()
+    monkeypatch.setattr(WorkerServer, 'ENTRY_PORT', port)
+    monkeypatch.setattr(WorkerServer, 'WORKER_PORT', _free_port())
+    server = WorkerServer({'env': {'env': 'TicTacToe'}, 'seed': 0})
+    server.run()
+    time.sleep(0.3)
+
+    got = entry({'server_address': 'localhost', 'num_parallel': 5,
+                 'address': 'testhost'})
+    assert got['worker']['base_worker_id'] == 0
+    assert got['worker']['num_parallel'] == 5
+    assert got['env'] == {'env': 'TicTacToe'}
+
+    got2 = entry({'server_address': 'localhost', 'num_parallel': 3,
+                  'address': 'testhost2'})
+    assert got2['worker']['base_worker_id'] == 5
+
+
+def test_network_battle_over_sockets(monkeypatch):
+    """Server env drives two remote RandomAgents purely through the
+    diff_info/string-action protocol."""
+    port = _free_port()
+    env_args = {'env': 'TicTacToe'}
+    random.seed(0)
+
+    def client():
+        conn = None
+        for _ in range(50):   # wait for the server socket to bind
+            try:
+                conn = connect_socket_connection('localhost', port)
+                conn.fileno()
+                conn.conn.getpeername()
+                break
+            except OSError:
+                time.sleep(0.1)
+        received_env_args = conn.recv()
+        env = make_env(received_env_args)
+        NetworkAgentClient(RandomAgent(), env, conn).run()
+
+    clients = [threading.Thread(target=client, daemon=True) for _ in range(2)]
+    for c in clients:
+        c.start()
+
+    agents_list = network_match_acception(1, env_args, 2, port)
+    agent_map = {p: agents_list[0][p] for p in (0, 1)}
+
+    env = make_env(env_args)
+    for _ in range(3):
+        result = exec_network_match(env, agent_map)
+        assert result is not None
+        outcome = result['result']
+        assert set(outcome.keys()) == {0, 1}
+        assert abs(outcome[0] + outcome[1]) < 1e-9   # zero-sum
