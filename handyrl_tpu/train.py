@@ -113,10 +113,15 @@ class Trainer:
 
         n_dev = len(jax.devices())
         self.mesh = make_mesh() if n_dev > 1 else None
+        # the step donates its input state (params/opt buffers reused in
+        # place); the actor-facing wrapper keeps its own copy of the params,
+        # refreshed only at epoch boundaries
         self.update_step = build_update_step(wrapper.module, self.cfg,
-                                             self.mesh, donate=False)
-        self.state: Optional[TrainState] = (
-            init_train_state(wrapper.params) if wrapper.params is not None else None)
+                                             self.mesh, donate=True)
+        self.state: Optional[TrainState] = None
+        if wrapper.params is not None:
+            own_params = jax.tree_util.tree_map(jnp.array, wrapper.params)
+            self.state = init_train_state(own_params)
 
         self.default_lr = 3e-8
         self.data_cnt_ema = args['batch_size'] * args['forward_steps']
@@ -126,6 +131,13 @@ class Trainer:
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
         self.shutdown_flag = False
+
+        # throughput + profiling (the reference has no tracing at all —
+        # SURVEY.md §5.1; here per-epoch step rate is tracked and a JAX
+        # profiler trace can be captured via train_args['profile_dir'])
+        self.last_steps_per_sec = 0.0
+        self._profile_dir = args.get('profile_dir') or ''
+        self._profiled = False
 
     def _lr(self) -> float:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
@@ -165,6 +177,14 @@ class Trainer:
 
         batch_cnt, data_cnt = 0, 0
         pending_metrics: List[Dict[str, jnp.ndarray]] = []
+        epoch_t0 = time.time()
+
+        if self._profile_dir and not self._profiled and self.steps > 0:
+            jax.profiler.start_trace(self._profile_dir)
+            self._profiled = True
+            profile_stop_at = self.steps + 20
+        else:
+            profile_stop_at = -1
 
         while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
             try:
@@ -184,6 +204,10 @@ class Trainer:
                 self._drain_metrics(pending_metrics)
                 pending_metrics = []
             self.steps += 1
+            if self.steps == profile_stop_at:
+                jax.block_until_ready(metrics['total'])
+                jax.profiler.stop_trace()
+                print('profiler trace written to %s' % self._profile_dir)
 
         if pending_metrics:
             data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
@@ -196,6 +220,7 @@ class Trainer:
 
         self.data_cnt_ema = (self.data_cnt_ema * 0.8
                              + data_cnt / (1e-2 + batch_cnt) * 0.2)
+        self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
     def _drain_metrics(self, pending: List[Dict[str, Any]]):
@@ -396,7 +421,9 @@ class Learner:
         if not self._metrics_path:
             return
         rec = {'epoch': self.model_epoch, 'steps': steps,
-               'episodes': self.num_returned_episodes, 'time': time.time()}
+               'episodes': self.num_returned_episodes, 'time': time.time(),
+               'sgd_steps_per_sec': round(self.trainer.last_steps_per_sec, 3),
+               'buffer': len(self.trainer.episodes)}
         gen = self.generation_results.get(self.model_epoch - 1)
         if gen:
             n, r, _ = gen
