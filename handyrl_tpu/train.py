@@ -165,10 +165,12 @@ class Trainer:
 
     def update(self):
         """Called by the learner at each epoch boundary; blocks until the
-        trainer hands over the new params."""
+        trainer hands over (params, steps, full-state blob). The blob is
+        serialized inside the trainer loop — the state buffers are donated
+        to the next compiled step, so nobody may touch them afterwards."""
         self.update_flag = True
-        params, steps = self.update_queue.get()
-        return params, steps
+        params, steps, state_blob = self.update_queue.get()
+        return params, steps, state_blob
 
     def train(self):
         if self.state is None:   # non-parametric model
@@ -240,10 +242,12 @@ class Trainer:
             print('started training')
         while not self.shutdown_flag:
             params = self.train()
+            state_blob = self.state_bytes() if self.state is not None else None
             self.update_flag = False
             while not self.shutdown_flag:
                 try:
-                    self.update_queue.put((params, self.steps), timeout=0.5)
+                    self.update_queue.put((params, self.steps, state_blob),
+                                          timeout=0.5)
                     break
                 except queue.Full:
                     continue
@@ -323,7 +327,7 @@ class Learner:
         return os.path.join(self.args.get('model_dir', 'models'),
                             'trainer_state.ckpt')
 
-    def update_model(self, params, steps: int):
+    def update_model(self, params, steps: int, state_blob: Optional[bytes] = None):
         print('updated model(%d)' % steps)
         self.model_epoch += 1
         self.wrapper.params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -332,9 +336,9 @@ class Learner:
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
             with open(path, 'wb') as f:
                 f.write(raw)
-        if self.trainer.state is not None:
+        if state_blob is not None:
             with open(self.trainer_state_path(), 'wb') as f:
-                f.write(self.trainer.state_bytes())
+                f.write(state_blob)
 
     # -- accounting -------------------------------------------------------
     def feed_episodes(self, episodes: List[Optional[dict]]):
@@ -410,10 +414,10 @@ class Learner:
             std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
             print('generation stats = %.3f +- %.3f' % (mean, std))
 
-        params, steps = self.trainer.update()
+        params, steps, state_blob = self.trainer.update()
         if params is None:
             params = self.wrapper.params
-        self.update_model(params, steps)
+        self.update_model(params, steps, state_blob)
         self._write_metrics(steps)
         self.flags = set()
 
