@@ -1,0 +1,154 @@
+"""Pallas TPU kernels for the backward target recursions.
+
+The TD(lambda)/UPGO/V-Trace recursions are T sequential elementwise steps
+over tiny (B, P, 1) slices — as ``lax.scan`` they compile to a T-iteration
+loop of small fused bodies. Here the whole backward pass is ONE Pallas
+kernel: data is laid out time-major as (T, N) with N = B*P padded to the
+128-lane tile, the T loop is unrolled inside the kernel (T is static), and
+every step is a VPU elementwise op on a full lane vector. One kernel launch,
+zero intermediate HBM traffic.
+
+Gradients never flow through targets (they consume stop_gradient'd values —
+losses.py), so no custom VJP is needed; callers get stop_gradient semantics.
+
+Used automatically on TPU backends (see ``use_pallas_targets``); the
+``lax.scan`` path in ops/targets.py remains the reference implementation and
+the fallback everywhere else. ``interpret=True`` makes the same kernels
+testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except ImportError:                      # pragma: no cover
+    _PALLAS_OK = False
+
+LANES = 128
+
+
+def use_pallas_targets() -> bool:
+    if not _PALLAS_OK:
+        return False
+    try:
+        return jax.default_backend() in ('tpu', 'axon')
+    except Exception:
+        return False
+
+
+# ---- kernels (refs are (T, N) or (1, N) VMEM blocks) ---------------------
+
+def _td_kernel(v_ref, g_ref, rew_ref, lam_ref, out_ref, *, T, gamma):
+    carry = g_ref[0, :]
+    out_ref[T - 1, :] = carry
+    for t in range(T - 2, -1, -1):
+        lam = lam_ref[t + 1, :]
+        carry = rew_ref[t, :] + gamma * ((1 - lam) * v_ref[t + 1, :] + lam * carry)
+        out_ref[t, :] = carry
+
+
+def _upgo_kernel(v_ref, g_ref, rew_ref, lam_ref, out_ref, *, T, gamma):
+    carry = g_ref[0, :]
+    out_ref[T - 1, :] = carry
+    for t in range(T - 2, -1, -1):
+        v_next = v_ref[t + 1, :]
+        lam = lam_ref[t + 1, :]
+        mixed = (1 - lam) * v_next + lam * carry
+        carry = rew_ref[t, :] + gamma * jnp.maximum(v_next, mixed)
+        out_ref[t, :] = carry
+
+
+def _vtrace_kernel(delta_ref, lamc_ref, out_ref, *, T, gamma):
+    """vmv_t = delta_t + gamma * (lam_{t+1} c_t) * vmv_{t+1}; lamc_ref holds
+    the pre-multiplied factor aligned at index t."""
+    carry = delta_ref[T - 1, :]
+    out_ref[T - 1, :] = carry
+    for t in range(T - 2, -1, -1):
+        carry = delta_ref[t, :] + gamma * lamc_ref[t, :] * carry
+        out_ref[t, :] = carry
+
+
+# ---- host-side wrappers --------------------------------------------------
+
+def _to_tn(x: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    """(B, T, P, 1) -> time-major (T, N_padded); returns (array, N)."""
+    B, T = x.shape[0], x.shape[1]
+    flat = jnp.moveaxis(x, 1, 0).reshape(T, -1)
+    N = flat.shape[1]
+    pad = (-N) % LANES
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat, N
+
+
+def _from_tn(tn: jnp.ndarray, shape) -> jnp.ndarray:
+    B, T, P = shape[0], shape[1], shape[2]
+    return jnp.moveaxis(tn[:, :B * P].reshape(T, B, P, 1), 0, 1)
+
+
+def _call(kernel, out_T, args, *, T, gamma, interpret):
+    specs = [pl.BlockSpec(memory_space=pltpu.VMEM) for _ in args]
+    return pl.pallas_call(
+        functools.partial(kernel, T=T, gamma=gamma),
+        out_shape=jax.ShapeDtypeStruct((out_T, args[0].shape[1]), jnp.float32),
+        in_specs=specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(*args)
+
+
+def td_lambda_pallas(values, returns, rewards, lambda_, gamma,
+                     interpret: bool = False):
+    shape = values.shape
+    T = shape[1]
+    v, _ = _to_tn(values)
+    lam, _ = _to_tn(lambda_)
+    rew, _ = _to_tn(rewards if rewards is not None else jnp.zeros_like(values))
+    g = _to_tn(returns[:, -1:])[0]
+    tvs = _call(_td_kernel, T, (v, g, rew, lam), T=T, gamma=gamma,
+                interpret=interpret)
+    tvs = _from_tn(tvs, shape)
+    return tvs, tvs - values
+
+
+def upgo_pallas(values, returns, rewards, lambda_, gamma,
+                interpret: bool = False):
+    shape = values.shape
+    T = shape[1]
+    v, _ = _to_tn(values)
+    lam, _ = _to_tn(lambda_)
+    rew, _ = _to_tn(rewards if rewards is not None else jnp.zeros_like(values))
+    g = _to_tn(returns[:, -1:])[0]
+    tvs = _call(_upgo_kernel, T, (v, g, rew, lam), T=T, gamma=gamma,
+                interpret=interpret)
+    tvs = _from_tn(tvs, shape)
+    return tvs, tvs - values
+
+
+def vtrace_pallas(values, returns, rewards, lambda_, gamma, rhos, cs,
+                  interpret: bool = False):
+    shape = values.shape
+    T = shape[1]
+    rew = rewards if rewards is not None else jnp.zeros_like(values)
+    v_next = jnp.concatenate([values[:, 1:], returns[:, -1:]], axis=1)
+    deltas = rhos * (rew + gamma * v_next - values)
+    # lamc aligned at t: lambda_{t+1} * c_t (last row unused)
+    lamc = jnp.concatenate([lambda_[:, 1:] * cs[:, :-1],
+                            jnp.zeros_like(cs[:, -1:])], axis=1)
+    d_tn, _ = _to_tn(deltas)
+    lamc_tn, _ = _to_tn(lamc)
+    vmv = _call(_vtrace_kernel, T, (d_tn, lamc_tn), T=T, gamma=gamma,
+                interpret=interpret)
+    vmv = _from_tn(vmv, shape)
+    vs = vmv + values
+    vs_next = jnp.concatenate([vs[:, 1:], returns[:, -1:]], axis=1)
+    advantages = rew + gamma * vs_next - values
+    return vs, advantages

@@ -1,0 +1,74 @@
+"""Pallas target kernels vs the lax.scan reference (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.ops import targets as ref
+from handyrl_tpu.ops import pallas_targets as pt
+
+B, T, P = 4, 16, 2
+SHAPE = (B, T, P, 1)
+
+
+def _rand(seed):
+    rng = np.random.RandomState(seed)
+    values = rng.randn(*SHAPE).astype(np.float32)
+    returns = rng.randn(*SHAPE).astype(np.float32)
+    rewards = rng.randn(*SHAPE).astype(np.float32)
+    rhos = rng.uniform(0.1, 1.0, SHAPE).astype(np.float32)
+    cs = rng.uniform(0.1, 1.0, SHAPE).astype(np.float32)
+    masks = (rng.rand(*SHAPE) > 0.3).astype(np.float32)
+    lambda_ = 0.7 + (1 - 0.7) * (1 - masks)
+    return values, returns, rewards, rhos, cs, lambda_
+
+
+@pytest.mark.parametrize('gamma', [1.0, 0.8])
+@pytest.mark.parametrize('use_rewards', [True, False])
+def test_td_pallas_matches_scan(gamma, use_rewards):
+    values, returns, rewards, _, _, lambda_ = _rand(0)
+    rew = rewards if use_rewards else None
+    want_t, want_a = ref.td_lambda(values, returns, rew, lambda_, gamma)
+    got_t, got_a = pt.td_lambda_pallas(values, returns, rew, lambda_, gamma,
+                                       interpret=True)
+    np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_a), np.asarray(want_a),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_upgo_pallas_matches_scan():
+    values, returns, rewards, _, _, lambda_ = _rand(1)
+    want_t, _ = ref.upgo(values, returns, rewards, lambda_, 0.9)
+    got_t, _ = pt.upgo_pallas(values, returns, rewards, lambda_, 0.9,
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_pallas_matches_scan():
+    values, returns, rewards, rhos, cs, lambda_ = _rand(2)
+    want_v, want_a = ref.vtrace(values, returns, rewards, lambda_, 0.9, rhos, cs)
+    got_v, got_a = pt.vtrace_pallas(values, returns, rewards, lambda_, 0.9,
+                                    rhos, cs, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_a), np.asarray(want_a),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_nonmultiple_of_128_lanes():
+    """B*P = 6 forces lane padding."""
+    rng = np.random.RandomState(3)
+    shape = (3, 5, 2, 1)
+    values = rng.randn(*shape).astype(np.float32)
+    returns = rng.randn(*shape).astype(np.float32)
+    lambda_ = np.full(shape, 0.7, np.float32)
+    want_t, _ = ref.td_lambda(values, returns, None, lambda_, 0.9)
+    got_t, _ = pt.td_lambda_pallas(values, returns, None, lambda_, 0.9,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cpu_backend_does_not_select_pallas():
+    assert pt.use_pallas_targets() is False  # tests run on the CPU backend
