@@ -278,6 +278,10 @@ class Learner:
 
         self.model_epoch = args['restart_epoch']
         module = net if net is not None else self.env.net()
+        compute_dtype = args.get('compute_dtype')
+        if compute_dtype and hasattr(module, 'dtype'):
+            # bf16 activations on the MXU; params stay float32
+            module = module.clone(dtype=jnp.dtype(compute_dtype))
         self.wrapper = ModelWrapper(module, seed=args['seed'])
         self.env.reset()
         self._example_obs = self.env.observation(self.env.players()[0])
