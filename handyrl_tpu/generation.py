@@ -227,3 +227,114 @@ class BatchedGenerator:
         self.envs[i].reset()
         self._hidden[i] = {p: self.wrapper.init_hidden()
                            for p in self.envs[i].players()}
+
+
+class BatchedEvaluator:
+    """Vectorized online evaluation: N concurrent matches of the trained
+    model (greedy, one rotating seat per match) against host-side opponents
+    (random / rule-based). The model seats across all matches share ONE
+    batched inference call per step, replacing the reference's sequential
+    B=1 evaluation matches (evaluation.py:159-177)."""
+
+    def __init__(self, make_env_fn, wrapper, args: Dict[str, Any],
+                 n_envs: int = 16):
+        from .agent import RandomAgent, RuleBasedAgent
+
+        self.envs = [make_env_fn(i) for i in range(n_envs)]
+        self.wrapper = wrapper
+        self.args = args
+        self.n_envs = n_envs
+        self._seat_counter = 0
+        self._opponents = (args.get('eval', {}).get('opponent', [])
+                          or ['random'])
+
+        def build_opponent(name):
+            if name.startswith('rulebase'):
+                key = name.split('-')[1] if '-' in name else None
+                return RuleBasedAgent(key)
+            return RandomAgent()
+
+        self._build_opponent = build_opponent
+        self._slot_state: List[dict] = [None] * n_envs
+        for i in range(n_envs):
+            self._start_match(i)
+
+    def _start_match(self, i: int):
+        env = self.envs[i]
+        env.reset()
+        players = env.players()
+        seat = players[self._seat_counter % len(players)]
+        self._seat_counter += 1
+        opponent = random.choice(self._opponents)
+        self._slot_state[i] = {
+            'seat': seat,
+            'opponent': opponent,
+            'agents': {p: self._build_opponent(opponent)
+                       for p in players if p != seat},
+            'hidden': self.wrapper.init_hidden(),
+        }
+
+    def step(self) -> List[dict]:
+        """Advance all matches one step; returns finished result records."""
+        jobs = []    # (env_idx, obs) for model seats to act
+        for i, env in enumerate(self.envs):
+            st = self._slot_state[i]
+            if st['seat'] in env.turns():
+                jobs.append((i, env.observation(st['seat'])))
+
+        policies = None
+        next_hidden = None
+        if jobs:
+            rows = len(jobs)
+            bucket = max(8, 1 << (rows - 1).bit_length())
+            pad = bucket - rows
+
+            def pad_rows(x):
+                if pad == 0:
+                    return x
+                return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
+
+            obs_batch = map_structure(pad_rows,
+                                      stack_structure([j[1] for j in jobs]))
+            hidden_batch = None
+            if self._slot_state[jobs[0][0]]['hidden'] is not None:
+                hidden_batch = map_structure(pad_rows, stack_structure(
+                    [self._slot_state[i]['hidden'] for i, _ in jobs]))
+            outputs = self.wrapper.batch_inference(obs_batch, hidden_batch)
+            policies = np.asarray(outputs['policy'])
+            next_hidden = outputs.get('hidden', None)
+
+        model_actions: Dict[int, int] = {}
+        for row, (i, _) in enumerate(jobs):
+            env = self.envs[i]
+            st = self._slot_state[i]
+            if next_hidden is not None:
+                st['hidden'] = map_structure(lambda a: np.asarray(a)[row],
+                                             next_hidden)
+            legal = env.legal_actions(st['seat'])
+            p = policies[row]
+            model_actions[i] = max(legal, key=lambda a: p[a])   # greedy
+
+        finished = []
+        for i, env in enumerate(self.envs):
+            st = self._slot_state[i]
+            actions = {}
+            for p in env.turns():
+                if p == st['seat']:
+                    actions[p] = model_actions.get(i)
+                else:
+                    actions[p] = st['agents'][p].action(env, p)
+            err = env.step(actions)
+            if err:
+                self._start_match(i)
+                continue
+            if env.terminal():
+                outcome = env.outcome()
+                eval_args = {'role': 'e', 'player': [st['seat']],
+                             'model_id': {p: (-1 if p != st['seat'] else 0)
+                                          for p in env.players()}}
+                finished.append({'args': eval_args,
+                                 'opponent': st['opponent'],
+                                 'result': outcome})
+                self._start_match(i)
+        return finished

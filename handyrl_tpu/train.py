@@ -46,9 +46,8 @@ import numpy as np
 import psutil
 
 from .environment import make_env, prepare_env
-from .generation import BatchedGenerator
-from .evaluation import Evaluator
-from .model import ModelWrapper, RandomModel
+from .generation import BatchedEvaluator, BatchedGenerator
+from .model import ModelWrapper
 from .ops.batch import make_batch, select_episode
 from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
@@ -458,9 +457,9 @@ class Learner:
 
         gen = BatchedGenerator(make_env_fn, actor, args,
                                n_envs=args.get('generation_envs', 64))
-        eval_env = make_env(env_args)
-        evaluator = Evaluator(eval_env, args)
-        random_model = RandomModel(self.wrapper, self._example_obs)
+        evaluator = BatchedEvaluator(
+            make_env_fn, actor, args,
+            n_envs=max(4, args.get('generation_envs', 64) // 8))
 
         prev_update_episodes = args['minimum_episodes']
         next_update_episodes = prev_update_episodes + args['update_episodes']
@@ -472,16 +471,12 @@ class Learner:
                 self.num_episodes += 1
             self.feed_episodes(episodes)
 
-            # keep evaluation share at eval_rate, mirroring the role split
-            while self.num_results < self.eval_rate * self.num_episodes:
-                p = self.env.players()[self.num_results % len(self.env.players())]
-                models = {q: (actor if q == p else None)
-                          for q in self.env.players()}
-                eval_args = {'role': 'e', 'player': [p],
-                             'model_id': {q: (self.model_epoch if q == p else -1)
-                                          for q in self.env.players()}}
-                self.num_results += 1
-                self.feed_results([evaluator.execute(models, eval_args)])
+            # keep the evaluation share near eval_rate: the vectorized
+            # evaluator advances all its matches one ply whenever behind
+            if self.num_results < self.eval_rate * self.num_episodes:
+                results = evaluator.step()
+                self.num_results += len(results)
+                self.feed_results(results)
 
             if self.num_returned_episodes >= next_update_episodes:
                 prev_update_episodes = next_update_episodes
