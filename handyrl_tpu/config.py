@@ -40,6 +40,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'generation_envs': 64,        # env count per batched actor
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
+    'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
     'compute_dtype': '',          # '' = float32; 'bfloat16' for MXU-friendly activations
     'profile_dir': '',            # when set, capture a jax profiler trace early in training
 }

@@ -149,9 +149,10 @@ class MultiProcessJobExecutor:
         self.waiting_conns: queue.Queue = queue.Queue()
         self.output_queue: queue.Queue = queue.Queue(maxsize=out_maxsize)
 
+        ctx = mp.get_context('spawn')   # never fork a TPU-holding parent
         for i in range(num_workers):
-            conn0, conn1 = mp.Pipe(duplex=True)
-            mp.Process(target=func, args=(conn1, i), daemon=True).start()
+            conn0, conn1 = ctx.Pipe(duplex=True)
+            ctx.Process(target=func, args=(conn1, i), daemon=True).start()
             conn1.close()
             self.conns.append(conn0)
             self.waiting_conns.put(conn0)

@@ -55,8 +55,24 @@ from .parallel.mesh import make_mesh, shard_batch
 from .worker import WorkerCluster, WorkerServer
 
 
+def _batcher_process(conn, bid: int):
+    """Child-process batch builder (config: batcher_processes=True)."""
+    from .connection import force_cpu_backend
+    force_cpu_backend()
+    print('started batcher process %d' % bid)
+    while True:
+        selected, args = conn.recv()
+        conn.send(make_batch(selected, args))
+
+
 class Batcher:
-    """Threaded batch prefetcher over the shared episode deque."""
+    """Batch prefetcher over the shared episode deque.
+
+    Default: prefetch threads (bz2/numpy release the GIL for the heavy
+    parts). With ``batcher_processes: True``, window selection stays in the
+    learner process and make_batch fans out to spawned CPU processes via
+    MultiProcessJobExecutor — the reference's num_batchers subprocess layout
+    (train.py:270-318)."""
 
     def __init__(self, args: Dict[str, Any], episodes: deque):
         self.args = args
@@ -65,11 +81,30 @@ class Batcher:
         self._started = False
         self.stop_flag = False
         self._threads: List[threading.Thread] = []
+        self._executor = None
+
+    def _selector(self):
+        while True:
+            selected = [select_episode(self.episodes, self.args)
+                        for _ in range(self.args['batch_size'])]
+            # strip non-picklable/irrelevant entries from the job payload
+            job_args = {k: v for k, v in self.args.items()
+                        if k in ('turn_based_training', 'observation',
+                                 'forward_steps', 'burn_in_steps',
+                                 'compress_steps', 'maximum_episodes')}
+            yield (selected, job_args)
 
     def run(self):
         if self._started:
             return
         self._started = True
+        if self.args.get('batcher_processes'):
+            from .connection import MultiProcessJobExecutor
+            self._executor = MultiProcessJobExecutor(
+                _batcher_process, self._selector(),
+                self.args['num_batchers'])
+            self._executor.start()
+            return
         for i in range(self.args['num_batchers']):
             t = threading.Thread(target=self._worker, args=(i,), daemon=True)
             t.start()
@@ -93,6 +128,8 @@ class Batcher:
                     continue
 
     def batch(self, timeout: Optional[float] = None):
+        if self._executor is not None:
+            return self._executor.output_queue.get(timeout=timeout)
         return self.output_queue.get(timeout=timeout)
 
     def stop(self):

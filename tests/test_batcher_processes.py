@@ -1,0 +1,23 @@
+"""Process-based batch building (batcher_processes=True) end to end."""
+
+import pytest
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.train import Learner
+
+
+@pytest.mark.timeout(600)
+def test_learner_with_process_batchers(tmp_path):
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 16, 'update_episodes': 25, 'minimum_episodes': 30,
+            'epochs': 1, 'generation_envs': 8, 'forward_steps': 8,
+            'num_batchers': 2, 'batcher_processes': True,
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    learner.run()
+    assert learner.model_epoch == 1
+    assert (tmp_path / 'models' / '1.ckpt').exists()

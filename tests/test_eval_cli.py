@@ -1,0 +1,33 @@
+"""Offline evaluation harness test: evaluate_mp in-process with a trained
+checkpoint vs random, with first/second balancing."""
+
+import random
+
+from handyrl_tpu.agent import Agent, RandomAgent
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.evaluation import evaluate_mp, wp_func
+from handyrl_tpu.model import ModelWrapper
+
+
+def test_evaluate_mp_single_process(capsys):
+    random.seed(0)
+    env_args = {'env': 'TicTacToe'}
+    env = make_env(env_args)
+    env.reset()
+    wrapper = ModelWrapper(env.net())
+    wrapper.ensure_params(env.observation(0))
+
+    agents = [Agent(wrapper), RandomAgent()]
+    evaluate_mp(env, agents, None, env_args, {'default': {}},
+                num_process=1, num_games=6, seed=1)
+    out = capsys.readouterr().out
+    assert 'total games = 6' in out
+    # both seat-balanced patterns appear
+    assert 'default-F' in out and 'default-S' in out
+    assert '---agent 0---' in out and '---agent 1---' in out
+
+
+def test_wp_func():
+    assert wp_func({1.0: 3, -1.0: 1}) == 0.75
+    assert wp_func({}) == 0.0
+    assert wp_func({0.0: 2}) == 0.5
