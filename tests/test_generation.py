@@ -1,0 +1,77 @@
+"""Generation data-contract tests: sampled probabilities, action masks,
+discounted returns, and episode accounting."""
+
+import random
+
+import numpy as np
+
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.generation import BatchedGenerator, Generator
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.ops.batch import decompress_moments
+from handyrl_tpu.utils.tree import softmax
+
+ARGS = {
+    'observation': False, 'gamma': 0.8, 'compress_steps': 4,
+}
+
+
+def _wrapper():
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    w = ModelWrapper(env.net())
+    w.ensure_params(env.observation(0))
+    return w
+
+
+def test_episode_moment_contract():
+    random.seed(3)
+    env = make_env({'env': 'TicTacToe'})
+    w = _wrapper()
+    gen = Generator(env, ARGS)
+    ep = gen.generate({0: w, 1: w}, {'player': [0, 1], 'model_id': {0: 1, 1: 1}})
+    assert ep is not None
+    moments = decompress_moments(ep['moment'])
+    assert len(moments) == ep['steps']
+
+    for t, m in enumerate(moments):
+        acting = m['turn'][0]
+        other = 1 - acting
+        assert acting == t % 2
+        # acting player recorded everything; the other observed nothing
+        assert m['action'][acting] is not None
+        assert m['observation'][other] is None
+        assert m['selected_prob'][other] is None
+        # action must have been legal under the recorded mask
+        assert m['action_mask'][acting][m['action'][acting]] == 0
+        # recorded prob equals the masked softmax prob of the taken action
+        # (recompute from the model deterministically)
+        obs = m['observation'][acting]
+        policy = w.inference(obs)['policy']
+        p = softmax(policy - m['action_mask'][acting])
+        np.testing.assert_allclose(m['selected_prob'][acting],
+                                   p[m['action'][acting]], rtol=1e-4)
+
+    # returns: discounted backward sum of rewards (TicTacToe has none -> 0)
+    for m in moments:
+        for pl in (0, 1):
+            assert m['return'][pl] == 0.0
+    assert set(ep['outcome'].values()) <= {1.0, -1.0, 0.0}
+
+
+def test_batched_generator_outcome_distribution():
+    random.seed(4)
+    w = _wrapper()
+    gen = BatchedGenerator(lambda i: make_env({'env': 'TicTacToe'}), w, ARGS,
+                           n_envs=16)
+    episodes = []
+    for _ in range(200):
+        episodes += gen.step()
+        if len(episodes) >= 40:
+            break
+    assert len(episodes) >= 40
+    # zero-sum: outcomes mirror
+    for ep in episodes:
+        assert abs(ep['outcome'][0] + ep['outcome'][1]) < 1e-9
+    lens = [ep['steps'] for ep in episodes]
+    assert 5 <= min(lens) and max(lens) <= 9
