@@ -77,7 +77,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     spec = P(None, axis, None, None)
     return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
