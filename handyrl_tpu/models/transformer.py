@@ -1,0 +1,112 @@
+"""Transformer policy net over board cells (attention-based model family).
+
+The reference has no attention models (its nets are conv/ConvLSTM); this
+family exists so attention-based policies are first-class, including
+long-context execution: set ``mesh``/``ring_axis`` and every attention layer
+runs as sequence-parallel ring attention (parallel/ring_attention.py) with
+the token axis sharded across devices; unset, it runs ordinary fused
+attention on one device.
+
+``GeeseFormer`` instantiates it for Hungry Geese: the 77 board cells become
+tokens (channel vector + learned position embedding), K pre-norm transformer
+blocks, policy read at the acting goose's head cell, value from head + mean
+pooling — the attention analog of GeeseNet's conv trunk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from ..parallel.ring_attention import full_attention, ring_attention
+
+
+class SelfAttention(nn.Module):
+    heads: int = 4
+    dim: int = 64
+    mesh: Optional[object] = None
+    ring_axis: str = 'model'
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):                     # x: (B, T, F)
+        B, T, F = x.shape
+        head_dim = self.dim // self.heads
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, self.heads, head_dim)
+        k = k.reshape(B, T, self.heads, head_dim)
+        v = v.reshape(B, T, self.heads, head_dim)
+        if self.mesh is not None:
+            out = ring_attention(q, k, v, self.mesh, self.ring_axis)
+        else:
+            out = full_attention(q, k, v)
+        out = out.reshape(B, T, self.dim)
+        return nn.Dense(F, use_bias=False, dtype=self.dtype)(out)
+
+
+class Block(nn.Module):
+    heads: int
+    dim: int
+    mesh: Optional[object] = None
+    ring_axis: str = 'model'
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = x + SelfAttention(self.heads, self.dim, self.mesh, self.ring_axis,
+                              dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
+        mlp = nn.Sequential([
+            nn.Dense(2 * self.dim, dtype=self.dtype), nn.gelu,
+            nn.Dense(x.shape[-1], dtype=self.dtype),
+        ])
+        return h + mlp(nn.LayerNorm(dtype=self.dtype)(h))
+
+
+@register('GeeseFormer')
+class GeeseFormer(nn.Module):
+    """Attention policy/value net for Hungry Geese (obs (..., 17, 7, 11))."""
+    dim: int = 64
+    layers: int = 4
+    heads: int = 4
+    pad_to: int = 80          # 77 cells padded so ring shards divide evenly
+    mesh: Optional[object] = None
+    ring_axis: str = 'model'
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        single = obs.ndim == 3
+        if single:
+            obs = obs[None]
+        B = obs.shape[0]
+        C = obs.shape[1]
+        cells = obs.reshape(B, C, -1).transpose(0, 2, 1)       # (B, 77, 17)
+        T = cells.shape[1]
+        pad = self.pad_to - T
+        if pad > 0:
+            cells = jnp.pad(cells, ((0, 0), (0, pad), (0, 0)))
+
+        tokens = nn.Dense(self.dim, dtype=self.dtype)(cells)
+        pos = self.param('pos_embed', nn.initializers.normal(0.02),
+                         (self.pad_to, self.dim))
+        tokens = tokens + pos.astype(self.dtype)
+
+        for _ in range(self.layers):
+            tokens = Block(self.heads, self.dim, self.mesh, self.ring_axis,
+                           dtype=self.dtype)(tokens)
+        tokens = nn.LayerNorm(dtype=self.dtype)(tokens)
+
+        head_mask = cells[..., :1]               # own-head channel is first
+        h_head = (tokens * head_mask).sum(axis=1)
+        h_avg = tokens.mean(axis=1)
+
+        policy = nn.Dense(4, use_bias=False, dtype=self.dtype)(h_head)
+        value = jnp.tanh(nn.Dense(1, use_bias=False, dtype=self.dtype)(
+            jnp.concatenate([h_head, h_avg], axis=-1)))
+        if single:
+            policy, value = policy[0], value[0]
+        return {'policy': policy, 'value': value}

@@ -1,0 +1,60 @@
+"""GeeseFormer: single-device vs sequence-parallel ring attention parity,
+and trainability through the compiled update step."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from handyrl_tpu.models import build
+from handyrl_tpu.parallel.mesh import make_mesh
+
+
+def _obs(B=4, seed=0):
+    rng = np.random.RandomState(seed)
+    obs = (rng.rand(B, 17, 7, 11) < 0.1).astype(np.float32)
+    obs[:, 0] = 0
+    for b in range(B):
+        obs[b, 0, rng.randint(7), rng.randint(11)] = 1.0   # own head
+    return obs
+
+
+def test_geese_former_shapes():
+    module = build('GeeseFormer', dim=32, layers=2, heads=2)
+    obs = _obs()
+    params = module.init(jax.random.PRNGKey(0), obs, None)
+    out = module.apply(params, obs, None)
+    assert out['policy'].shape == (4, 4)
+    assert out['value'].shape == (4, 1)
+
+
+def test_ring_mesh_matches_single_device():
+    """Same params, attention over the ring vs on one device."""
+    mesh = make_mesh(model_parallel=4)     # ('data', 'model') = (2, 4)
+    single = build('GeeseFormer', dim=32, layers=2, heads=2)
+    ringed = build('GeeseFormer', dim=32, layers=2, heads=2,
+                   mesh=mesh, ring_axis='model')
+    obs = _obs(B=2, seed=1)
+    params = single.init(jax.random.PRNGKey(0), obs, None)
+    want = single.apply(params, obs, None)
+    got = ringed.apply(params, obs, None)
+    np.testing.assert_allclose(np.asarray(got['policy']),
+                               np.asarray(want['policy']), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got['value']),
+                               np.asarray(want['value']), rtol=2e-4, atol=2e-5)
+
+
+def test_geese_former_trains():
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
+    from __graft_entry__ import _synthetic_batch
+
+    rng = np.random.RandomState(2)
+    batch = _synthetic_batch(4, 6, 1, (17, 7, 11), 4, rng)
+    module = build('GeeseFormer', dim=32, layers=2, heads=2)
+    params = module.init(jax.random.PRNGKey(0), batch['observation'][:, 0, 0], None)
+    state = init_train_state(params)
+    cfg = LossConfig(turn_based_training=False, observation=True,
+                     policy_target='VTRACE', value_target='VTRACE', gamma=0.99)
+    step = build_update_step(module, cfg, donate=False)
+    state2, metrics = step(state, batch, jnp.asarray(1e-4, jnp.float32))
+    assert np.isfinite(float(metrics['total']))
