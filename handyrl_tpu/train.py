@@ -224,15 +224,26 @@ class Trainer:
         else:
             profile_stop_at = -1
 
-        while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
+        staged = None   # one-slot H2D prefetch: upload batch t+1 while t runs
+
+        def stage_next():
             try:
-                batch = self.batcher.batch(timeout=1.0)
+                nxt = self.batcher.batch(timeout=1.0)
             except queue.Empty:
-                continue
+                return None
             if self.mesh is not None:
-                batch = shard_batch(self.mesh, batch)
+                return shard_batch(self.mesh, nxt)
+            return jax.tree_util.tree_map(jnp.asarray, nxt)
+
+        while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
+            batch = staged if staged is not None else stage_next()
+            staged = None
+            if batch is None:
+                continue
             lr = jnp.asarray(self._lr(), jnp.float32)
             self.state, metrics = self.update_step(self.state, batch, lr)
+            # device_put of the next batch overlaps with the running step
+            staged = stage_next()
             pending_metrics.append(metrics)
             batch_cnt += 1
             # data_count is a device scalar; fetch lazily every few steps to
