@@ -75,6 +75,7 @@ class GeeseFormer(nn.Module):
     pad_to: int = 80          # 77 cells padded so ring shards divide evenly
     mesh: Optional[object] = None
     ring_axis: str = 'model'
+    remat: bool = False       # rematerialize blocks: trade FLOPs for HBM
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -95,9 +96,10 @@ class GeeseFormer(nn.Module):
                          (self.pad_to, self.dim))
         tokens = tokens + pos.astype(self.dtype)
 
+        block_cls = nn.remat(Block) if self.remat else Block
         for _ in range(self.layers):
-            tokens = Block(self.heads, self.dim, self.mesh, self.ring_axis,
-                           dtype=self.dtype)(tokens)
+            tokens = block_cls(self.heads, self.dim, self.mesh, self.ring_axis,
+                               dtype=self.dtype)(tokens)
         tokens = nn.LayerNorm(dtype=self.dtype)(tokens)
 
         head_mask = cells[..., :1]               # own-head channel is first

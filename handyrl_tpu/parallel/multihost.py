@@ -1,0 +1,62 @@
+"""Multi-host TPU initialization helpers.
+
+On a multi-host pod slice every host runs the same program; JAX needs the
+distributed runtime initialized before first use so `jax.devices()` sees the
+global device set. The learner's mesh helpers (parallel/mesh.py) then span
+hosts transparently: data-parallel sharding puts the gradient all-reduce on
+ICI within a slice and DCN across slices.
+
+Typical launch (one learner process per host):
+
+    from handyrl_tpu.parallel import multihost
+    multihost.initialize()           # no-op on single-host
+    ...
+    train_main(args)
+
+Worker hosts (CPU episode generators) do NOT call this — they are plain
+processes speaking the framed-TCP protocol to the learner host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Initialize jax.distributed when running multi-host; returns True when
+    distributed mode was activated.
+
+    With no arguments, uses the standard cluster-environment autodetection
+    (TPU pod metadata / JAX_COORDINATOR_ADDRESS etc.); single-host runs are
+    detected and left untouched.
+    """
+    import jax
+
+    explicit = coordinator_address is not None
+    env_driven = any(os.environ.get(k) for k in
+                     ('JAX_COORDINATOR_ADDRESS', 'COORDINATOR_ADDRESS',
+                      'MEGASCALE_COORDINATOR_ADDRESS'))
+    if not explicit and not env_driven:
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh(model_parallel: int = 1):
+    """Mesh over ALL devices in the (possibly multi-host) job."""
+    from .mesh import make_mesh
+    import jax
+    return make_mesh(jax.devices(), model_parallel=model_parallel)
+
+
+def is_coordinator() -> bool:
+    import jax
+    return jax.process_index() == 0
