@@ -262,14 +262,15 @@ class Trainer:
             data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
             self._drain_metrics(pending_metrics)
 
-        loss_sum = self._loss_sum
-        self._loss_sum = {}
-        print('loss = %s' % ' '.join(
-            [k + ':' + '%.3f' % (l / max(data_cnt, 1)) for k, l in loss_sum.items()]))
-
-        self.data_cnt_ema = (self.data_cnt_ema * 0.8
-                             + data_cnt / (1e-2 + batch_cnt) * 0.2)
-        self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
+        if batch_cnt > 0:   # zero only when interrupted by shutdown
+            loss_sum = self._loss_sum
+            self._loss_sum = {}
+            print('loss = %s' % ' '.join(
+                [k + ':' + '%.3f' % (l / max(data_cnt, 1))
+                 for k, l in loss_sum.items()]))
+            self.data_cnt_ema = (self.data_cnt_ema * 0.8
+                                 + data_cnt / (1e-2 + batch_cnt) * 0.2)
+            self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
     def _drain_metrics(self, pending: List[Dict[str, Any]]):

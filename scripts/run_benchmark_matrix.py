@@ -1,0 +1,109 @@
+"""Run the BASELINE.md measurement matrix configs and record results.
+
+Each row trains a config for a fixed number of epochs and records
+throughput (episodes/sec, SGD steps/sec) and the aggregate win rate vs
+random over the last 5 epochs, appending JSON rows to benchmarks.jsonl.
+
+Usage: python scripts/run_benchmark_matrix.py [ROW ...] [--epochs N]
+Rows: ttt-td ttt-vtrace geister geese
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+ROWS = {
+    'ttt-td': {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'batch_size': 64, 'forward_steps': 8,
+                       'update_episodes': 200, 'minimum_episodes': 400,
+                       'generation_envs': 64},
+    },
+    'ttt-vtrace': {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'batch_size': 64, 'forward_steps': 8,
+                       'update_episodes': 200, 'minimum_episodes': 400,
+                       'generation_envs': 64,
+                       'policy_target': 'UPGO', 'value_target': 'VTRACE'},
+    },
+    'geister': {
+        'env_args': {'env': 'Geister'},
+        'train_args': {'batch_size': 32, 'forward_steps': 16,
+                       'burn_in_steps': 4, 'update_episodes': 100,
+                       'minimum_episodes': 200, 'generation_envs': 32,
+                       'observation': True},
+    },
+    'geese': {
+        'env_args': {'env': 'HungryGeese'},
+        'train_args': {'batch_size': 64, 'forward_steps': 16,
+                       'update_episodes': 100, 'minimum_episodes': 200,
+                       'generation_envs': 32,
+                       'turn_based_training': False, 'observation': True,
+                       'gamma': 0.99,
+                       'policy_target': 'VTRACE', 'value_target': 'VTRACE'},
+    },
+}
+
+
+def run_row(name, epochs):
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.train import Learner
+
+    raw = json.loads(json.dumps(ROWS[name]))   # deep copy
+    raw['train_args']['epochs'] = epochs
+    raw['train_args']['model_dir'] = 'models_bench_%s' % name
+    args = apply_defaults(raw)
+
+    t0 = time.time()
+    learner = Learner(args=args)
+    learner.run()
+    wall = time.time() - t0
+
+    last = learner.model_epoch - 1
+    n = r = 0
+    for epoch in range(max(1, last - 4), last + 1):
+        if epoch in learner.results:
+            en, er, _ = learner.results[epoch]
+            n, r = n + en, r + er
+    win_rate = (r / (n + 1e-6) + 1) / 2 if n else None
+
+    import jax
+    row = {
+        'row': name, 'backend': jax.default_backend(),
+        'epochs': learner.model_epoch,
+        'episodes': learner.num_returned_episodes,
+        'episodes_per_sec': round(learner.num_returned_episodes / wall, 2),
+        'sgd_steps_per_sec': round(learner.trainer.last_steps_per_sec, 2),
+        'win_rate_vs_random_last5': round(win_rate, 3) if win_rate else None,
+        'eval_games': n, 'wall_s': round(wall, 1),
+        'time': time.strftime('%Y-%m-%d %H:%M:%S'),
+    }
+    with open('benchmarks.jsonl', 'a') as f:
+        f.write(json.dumps(row) + '\n')
+    print(json.dumps(row))
+
+
+def main():
+    if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    epochs = 10
+    rows = []
+    for a in sys.argv[1:]:
+        if a.startswith('--epochs='):
+            epochs = int(a.split('=', 1)[1])
+        elif a in ROWS:
+            rows.append(a)
+        else:
+            raise SystemExit('unknown row %r (choose from %s, or --epochs=N)'
+                             % (a, ', '.join(ROWS)))
+    rows = rows or ['ttt-td']
+    for name in rows:
+        run_row(name, epochs)
+
+
+if __name__ == '__main__':
+    main()
