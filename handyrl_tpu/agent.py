@@ -8,7 +8,7 @@ SoftAgent (temperature 1).
 from __future__ import annotations
 
 import random
-from typing import Any, List, Optional
+from typing import Optional
 
 import numpy as np
 

@@ -21,7 +21,7 @@ import queue
 import socket
 import struct
 import threading
-from typing import Any, Callable, Iterator, List, Optional
+from typing import Callable, Iterator, List, Optional
 
 
 def send_recv(conn, data):

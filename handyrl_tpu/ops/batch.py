@@ -25,7 +25,7 @@ from __future__ import annotations
 import bz2
 import pickle
 import random
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Sequence
 
 import numpy as np
 

@@ -12,7 +12,7 @@ replicated; XLA inserts the gradient all-reduce over ICI.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ the 8-device CPU mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

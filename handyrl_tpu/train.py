@@ -29,7 +29,6 @@ model) match the reference so its plot tooling carries over (SURVEY.md §5.5).
 
 from __future__ import annotations
 
-import copy
 import json
 import os
 import queue
