@@ -19,9 +19,26 @@ import time
 import numpy as np
 
 
+def _wait_for_backend(retries: int = 6, delay: float = 20.0):
+    """The axon TPU tunnel can be transiently unavailable (exclusive
+    single-client grant); retry init with backoff before giving up."""
+    import jax
+    for attempt in range(retries):
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            if attempt == retries - 1:
+                raise
+            print('# backend unavailable (%s); retry %d/%d in %.0fs'
+                  % (str(e).splitlines()[0][:80], attempt + 1, retries, delay),
+                  flush=True)
+            time.sleep(delay)
+
+
 def main():
     import jax
     import jax.numpy as jnp
+    _wait_for_backend()
     from handyrl_tpu.models import build
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.train_step import build_update_step, init_train_state
