@@ -1,0 +1,60 @@
+"""Geister imperfect-information guarantees: per-player observations hide
+opponent piece types; the omniscient view doesn't; second player sees a
+rotated board."""
+
+import random
+
+import numpy as np
+
+from handyrl_tpu.envs.geister import Environment
+
+
+def _setup_env():
+    random.seed(5)
+    e = Environment()
+    e.play(144 + 3)    # black picks layout 3
+    e.play(144 + 10)   # white picks layout 10
+    return e
+
+
+def test_player_view_hides_opponent_types():
+    e = _setup_env()
+    for viewer in (0, 1):
+        obs = e.observation(viewer)
+        board = obs['board']
+        # channels 5/6 (opponent blue/red split) must be all-zero
+        assert np.all(board[5] == 0)
+        assert np.all(board[6] == 0)
+        # but the opponent's pieces ARE visible as a union (channel 2)
+        assert board[2].sum() == 8
+
+
+def test_omniscient_view_reveals_types():
+    e = _setup_env()
+    obs = e.observation(None)
+    board = obs['board']
+    assert board[5].sum() == 4      # opponent blues
+    assert board[6].sum() == 4      # opponent reds
+
+
+def test_second_player_sees_rotated_board():
+    e = _setup_env()
+    obs0 = e.observation(None)                  # black to move, black's view
+    e.play(random.choice(e.legal_actions()))    # now white to move
+    obs1 = e.observation(None)                  # white's view
+    # white's own-piece plane equals black's opponent plane rotated 180
+    np.testing.assert_array_equal(
+        obs1['board'][1], np.rot90(obs0['board'][2], 2))
+
+
+def test_scalar_features_track_piece_counts():
+    e = _setup_env()
+    obs = e.observation(0)
+    s = obs['scalar']
+    assert s.shape == (18,)
+    assert s[0] == 1.0                 # viewing player is black
+    # 4 blues and 4 reds each side -> the '==4' one-hot of each group is set
+    assert s[2 + 3] == 1.0             # my blues == 4
+    assert s[6 + 3] == 1.0             # my reds == 4
+    assert s[10 + 3] == 1.0            # opp blues == 4
+    assert s[14 + 3] == 1.0            # opp reds == 4
