@@ -181,6 +181,23 @@ class BatchedGenerator:
         returns_head = np.asarray(outputs['return']) if 'return' in outputs else None
         next_hidden = outputs.get('hidden', None)
 
+        # vectorized categorical sampling for all acting rows at once:
+        # mask illegal logits, then Gumbel-max (== sampling from the masked
+        # softmax); selected_prob comes from the same masked softmax
+        acting_rows = [r for r, j in enumerate(jobs) if j[2]]
+        if acting_rows:
+            amasks = np.full((len(acting_rows),) + policies.shape[1:], 1e32,
+                             np.float32)
+            for n, r in enumerate(acting_rows):
+                i, player, _, _ = jobs[r]
+                amasks[n][self.envs[i].legal_actions(player)] = 0
+            masked = policies[acting_rows] - amasks
+            probs = softmax(masked)
+            gumbel = -np.log(-np.log(
+                np.random.random_sample(masked.shape) + 1e-12) + 1e-12)
+            sampled = np.argmax(masked + gumbel, axis=-1)
+        row_to_sample = {r: n for n, r in enumerate(acting_rows)}
+
         # scatter results back into per-env moments
         pending: Dict[int, dict] = {}
         for row, (i, player, acting, obs) in enumerate(jobs):
@@ -196,10 +213,10 @@ class BatchedGenerator:
                 self._hidden[i][player] = map_structure(
                     lambda a: np.asarray(a)[row], next_hidden)
             if acting:
-                action, prob, amask = _sample_action(
-                    policies[row], env.legal_actions(player))
-                moment['selected_prob'][player] = prob
-                moment['action_mask'][player] = amask
+                n = row_to_sample[row]
+                action = int(sampled[n])
+                moment['selected_prob'][player] = probs[n, action]
+                moment['action_mask'][player] = amasks[n]
                 moment['action'][player] = action
 
         finished: List[dict] = []
