@@ -235,13 +235,24 @@ class WorkerServer(QueueCommunicator):
                          daemon=True).start()
 
 
-def entry(worker_args):
-    conn = connect_socket_connection(worker_args['server_address'],
-                                     WorkerServer.ENTRY_PORT)
-    conn.send(worker_args)
-    args = conn.recv()
-    conn.close()
-    return args
+def entry(worker_args, retries: int = 30, delay: float = 2.0):
+    """Entry handshake with retry: the learner may still be starting (jax
+    import + bind) when a worker host comes up."""
+    last_err = None
+    for _ in range(retries):
+        try:
+            conn = connect_socket_connection(worker_args['server_address'],
+                                             WorkerServer.ENTRY_PORT)
+            conn.send(worker_args)
+            args = conn.recv()
+            conn.close()
+            return args
+        except (OSError, ConnectionResetError) as e:
+            last_err = e
+            time.sleep(delay)
+    raise ConnectionError('could not reach training server at %s:%d (%s)'
+                          % (worker_args['server_address'],
+                             WorkerServer.ENTRY_PORT, last_err))
 
 
 class RemoteWorkerCluster:
