@@ -1,0 +1,84 @@
+"""Stress tests: concurrent buffer feed/sample (the learner/batcher thread
+pair) and window-selection fuzzing against batch invariants."""
+
+import random
+import threading
+from collections import deque
+
+import numpy as np
+
+from handyrl_tpu.ops.batch import make_batch, select_episode
+from helpers import turn_based_episode, train_args
+
+
+def test_concurrent_feed_and_select():
+    """Feeder thread extends/trims the deque while samplers select windows —
+    the GIL-atomic deque contract the trainer relies on (reference
+    train.py:472-483); IndexError is retried internally."""
+    episodes = deque(turn_based_episode(6, seed=i) for i in range(50))
+    args = train_args(forward_steps=4)
+    args['maximum_episodes'] = 80
+    stop = threading.Event()
+    errors = []
+
+    def feeder():
+        i = 100
+        while not stop.is_set():
+            episodes.extend([turn_based_episode(6, seed=i)])
+            i += 1
+            while len(episodes) > 80:
+                episodes.popleft()
+
+    def sampler():
+        try:
+            for _ in range(300):
+                w = select_episode(episodes, args)
+                batch = make_batch([w], args)
+                assert batch['observation'].shape[0] == 1
+        except Exception as e:      # pragma: no cover
+            errors.append(e)
+
+    feed_thread = threading.Thread(target=feeder, daemon=True)
+    sample_threads = [threading.Thread(target=sampler, daemon=True)
+                      for _ in range(2)]
+    feed_thread.start()
+    for t in sample_threads:
+        t.start()
+    for t in sample_threads:
+        t.join(timeout=120)
+    stop.set()
+    feed_thread.join(timeout=5)
+    assert not errors, errors
+
+
+def test_make_batch_fuzz_invariants():
+    """Random episode lengths / window positions / burn-in: shapes and mask
+    algebra must always hold."""
+    random.seed(7)
+    rng = np.random.RandomState(7)
+    for trial in range(30):
+        steps = rng.randint(1, 12)
+        fs = rng.randint(1, 10)
+        burn = rng.randint(0, 4)
+        ep = turn_based_episode(steps, seed=trial)
+        args = train_args(forward_steps=fs, burn_in=burn)
+        w = select_episode([ep], args)
+        batch = make_batch([w], args)
+
+        T = burn + fs
+        assert batch['observation'].shape[:3] == (1, T, 1)
+        assert batch['turn_mask'].shape == (1, T, 2, 1)
+        emask = batch['episode_mask'][0, :, 0, 0]
+        tmask = batch['turn_mask'][0]
+        omask = batch['observation_mask'][0]
+        # outside the episode nothing is acted/observed
+        assert np.all(tmask[emask == 0] == 0)
+        assert np.all(omask[emask == 0] == 0)
+        # inside the window exactly one player acts per step
+        assert np.all(tmask.sum(axis=1)[emask == 1] == 1)
+        # padded probs are exactly 1 (=> zero log-prob contribution)
+        probs = batch['selected_prob'][0, :, 0, 0]
+        assert np.all(probs[emask == 0] == 1.0)
+        # progress within [0, 1]
+        assert batch['progress'].min() >= 0.0
+        assert batch['progress'].max() <= 1.0
