@@ -25,6 +25,20 @@ ENVS = {
     'HungryGeese': 'handyrl_tpu.envs.kaggle.hungry_geese',
 }
 
+# Pure-JAX twins: envs re-implemented as jittable array functions for
+# fully device-resident rollouts (device_generation.py).
+JAX_ENVS = {
+    'TicTacToe': 'handyrl_tpu.envs.jax_tictactoe',
+}
+
+
+def make_jax_env(env_args: Dict[str, Any]):
+    """Return the pure-JAX twin module for an env, or None."""
+    name = env_args['env']
+    if name not in JAX_ENVS:
+        return None
+    return importlib.import_module(JAX_ENVS[name])
+
 
 def _resolve_module(env_args: Dict[str, Any]):
     name = env_args['env']

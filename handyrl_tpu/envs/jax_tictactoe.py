@@ -1,0 +1,93 @@
+"""Pure-JAX vectorized TicTacToe: the whole environment as jittable array
+functions.
+
+The host-side envs (envs/tictactoe.py) mirror the reference's Python-object
+protocol; this module is the fully TPU-resident counterpart used by the
+device rollout engine (device_generation.py): N boards advance as one
+program — reset, legal mask, win detection, observation encoding and
+auto-reset are all jnp ops, so self-play stepping never leaves the chip.
+
+State pytree (all leaves have leading env axis N):
+  boards  (N, 9)  int8   +1 black / -1 white / 0 empty
+  side    (N,)    int8   side to move (+1/-1)
+  winner  (N,)    int8   +1/-1 when decided, 0 otherwise
+  moves   (N,)    int8   plies played
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .tictactoe import WIN_LINES
+
+N_ACTIONS = 9
+MAX_STEPS = 9
+NUM_PLAYERS = 2
+
+
+class State(NamedTuple):
+    boards: jnp.ndarray
+    side: jnp.ndarray
+    winner: jnp.ndarray
+    moves: jnp.ndarray
+
+
+def init_state(n: int) -> State:
+    return State(
+        boards=jnp.zeros((n, 9), jnp.int8),
+        side=jnp.ones((n,), jnp.int8),
+        winner=jnp.zeros((n,), jnp.int8),
+        moves=jnp.zeros((n,), jnp.int8),
+    )
+
+
+def legal_mask(state: State) -> jnp.ndarray:
+    """(N, 9) float 1 = legal."""
+    return (state.boards == 0).astype(jnp.float32)
+
+
+def terminal(state: State) -> jnp.ndarray:
+    return (state.winner != 0) | (state.moves >= MAX_STEPS)
+
+
+def turn(state: State) -> jnp.ndarray:
+    """Acting player index (0/1) per env."""
+    return (state.moves % 2).astype(jnp.int32)
+
+
+def observe(state: State) -> jnp.ndarray:
+    """Side-to-move view planes (N, 3, 3, 3): [const 1, mine, theirs]."""
+    mine = (state.boards == state.side[:, None]).astype(jnp.float32)
+    theirs = (state.boards == -state.side[:, None]).astype(jnp.float32)
+    ones = jnp.ones_like(mine)
+    planes = jnp.stack([ones, mine, theirs], axis=1)       # (N, 3, 9)
+    return planes.reshape(-1, 3, 3, 3)
+
+
+def step(state: State, actions: jnp.ndarray) -> State:
+    """Apply one action per env (envs already terminal are left unchanged by
+    the caller via auto-reset)."""
+    n = state.boards.shape[0]
+    boards = state.boards.at[jnp.arange(n), actions].set(state.side)
+    line_sums = boards[:, WIN_LINES].sum(axis=2)           # (N, 8)
+    won = (line_sums == 3 * state.side[:, None].astype(jnp.int32)).any(axis=1)
+    winner = jnp.where(won & (state.winner == 0), state.side, state.winner)
+    return State(boards=boards, side=-state.side,
+                 winner=winner.astype(jnp.int8),
+                 moves=state.moves + 1)
+
+
+def outcome(state: State) -> jnp.ndarray:
+    """(N, 2) outcome per player seat (player 0 is black)."""
+    w = state.winner.astype(jnp.float32)
+    return jnp.stack([w, -w], axis=1)
+
+
+def auto_reset(state: State, done: jnp.ndarray) -> State:
+    """Replace finished envs with fresh boards."""
+    fresh = init_state(state.boards.shape[0])
+    pick = lambda a, b: jnp.where(done.reshape((-1,) + (1,) * (a.ndim - 1)), a, b)
+    return State(*(pick(f, s) for f, s in zip(fresh, state)))

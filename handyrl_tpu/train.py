@@ -503,8 +503,21 @@ class Learner:
             e = make_env({**env_args, 'id': i})
             return e
 
-        gen = BatchedGenerator(make_env_fn, actor, args,
-                               n_envs=args.get('generation_envs', 64))
+        gen = None
+        if args.get('device_generation'):
+            from .environment import make_jax_env
+            from .device_generation import DeviceGenerator
+            env_mod = make_jax_env(env_args)
+            if env_mod is not None:
+                gen = DeviceGenerator(env_mod, actor, args,
+                                      n_envs=args.get('generation_envs', 64))
+                gen.step = gen.step_chunk   # same streaming surface
+            else:
+                print('no pure-JAX twin for %s; falling back to host envs'
+                      % env_args['env'])
+        if gen is None:
+            gen = BatchedGenerator(make_env_fn, actor, args,
+                                   n_envs=args.get('generation_envs', 64))
         evaluator = BatchedEvaluator(
             make_env_fn, actor, args,
             n_envs=max(4, args.get('generation_envs', 64) // 8))

@@ -1,0 +1,88 @@
+"""Device-resident generation: pure-JAX env correctness and episode-record
+compatibility with the standard batch builder."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from handyrl_tpu.envs import jax_tictactoe as jttt
+from handyrl_tpu.envs.tictactoe import Environment as HostTicTacToe
+from handyrl_tpu.device_generation import DeviceGenerator
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.models.tictactoe import SimpleConv2dModel
+from handyrl_tpu.ops.batch import decompress_moments, make_batch, select_episode
+from helpers import train_args
+
+
+def test_jax_env_matches_host_env():
+    """Drive both envs with the same action sequence; states must agree."""
+    state = jttt.init_state(1)
+    host = HostTicTacToe()
+    rng = np.random.RandomState(0)
+    while not host.terminal():
+        legal = host.legal_actions()
+        a = int(rng.choice(legal))
+        # device legal mask agrees
+        dev_legal = np.flatnonzero(np.asarray(jttt.legal_mask(state))[0]).tolist()
+        assert dev_legal == legal
+        # observations agree (side-to-move view)
+        np.testing.assert_array_equal(
+            np.asarray(jttt.observe(state))[0], host.observation(host.turn()))
+        state = jttt.step(state, jnp.asarray([a]))
+        host.play(a)
+    assert bool(jttt.terminal(state)[0])
+    oc = np.asarray(jttt.outcome(state))[0]
+    host_oc = host.outcome()
+    assert oc[0] == host_oc[0] and oc[1] == host_oc[1]
+
+
+def test_device_generator_episodes_valid():
+    wrapper = ModelWrapper(SimpleConv2dModel())
+    host = HostTicTacToe()
+    wrapper.ensure_params(host.observation(0))
+    args = train_args(forward_steps=8)
+    args['gamma'] = 0.8
+    gen = DeviceGenerator(jttt, wrapper, args, n_envs=16, chunk_steps=16)
+
+    episodes = []
+    for _ in range(4):
+        episodes += gen.step_chunk()
+    assert len(episodes) >= 16
+
+    for ep in episodes[:10]:
+        assert 5 <= ep['steps'] <= 9
+        assert abs(ep['outcome'][0] + ep['outcome'][1]) < 1e-9
+        moments = decompress_moments(ep['moment'])
+        assert len(moments) == ep['steps']
+        # replay the recorded actions through the host env: all legal,
+        # and the final outcome matches
+        host = HostTicTacToe()
+        host.reset()
+        for t, m in enumerate(moments):
+            player = m['turn'][0]
+            assert player == t % 2
+            action = m['action'][player]
+            assert action in host.legal_actions()
+            assert m['action_mask'][player][action] == 0
+            host.play(action)
+        assert host.terminal()
+        assert host.outcome() == ep['outcome']
+
+    # records feed the standard batch builder unchanged
+    batch = make_batch([select_episode(episodes, args) for _ in range(4)], args)
+    assert batch['observation'].shape[:3] == (4, 8, 1)
+    assert np.isfinite(np.asarray(batch['selected_prob'])).all()
+
+
+def test_device_generator_throughput_smoke():
+    """One compiled dispatch advances all envs one ply — just assert the
+    chunk API returns steadily without recompiles (same shapes)."""
+    wrapper = ModelWrapper(SimpleConv2dModel())
+    host = HostTicTacToe()
+    wrapper.ensure_params(host.observation(0))
+    args = train_args(forward_steps=8)
+    gen = DeviceGenerator(jttt, wrapper, args, n_envs=8, chunk_steps=8)
+    total = 0
+    for _ in range(6):
+        total += len(gen.step_chunk())
+    assert total >= 5

@@ -1,0 +1,21 @@
+"""Learner end to end with fully device-resident generation."""
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.train import Learner
+
+
+def test_learner_device_generation(tmp_path):
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 16, 'update_episodes': 40, 'minimum_episodes': 40,
+            'epochs': 2, 'generation_envs': 16, 'forward_steps': 8,
+            'num_batchers': 1, 'device_generation': True,
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    learner.run()
+    assert learner.model_epoch == 2
+    assert learner.num_returned_episodes >= 80
+    assert (tmp_path / 'models' / '2.ckpt').exists()
