@@ -2,12 +2,20 @@
 
 The BatchedGenerator (generation.py) still crosses the host boundary once
 per ply (observations up, policies down). For environments implemented as
-pure JAX functions (envs/jax_tictactoe.py), this engine runs K plies of N
-environments as ONE compiled program — inference, legal masking, categorical
-sampling, transition, win detection and auto-reset all stay in HBM; the host
-receives a (K, N, ...) trajectory chunk and only splices completed episodes
-into the standard episode records (the same wire/batch format as every other
-generator, generation.py:84-91 in the reference).
+pure JAX functions (envs/jax_tictactoe.py, envs/jax_hungry_geese.py), this
+engine runs K plies of N environments as ONE compiled program — inference,
+legal masking, categorical sampling, transition, termination detection and
+auto-reset all stay in HBM; the host receives a (K, N, ...) trajectory chunk
+and only splices completed episodes into the standard episode records (the
+same wire/batch format as every other generator, generation.py:84-91 in the
+reference).
+
+Two env protocols:
+  * turn-based (jax_tictactoe): observe -> (N, ...) side-to-move view,
+    step((N,) actions), turn -> (N,) acting seat;
+  * simultaneous (SIMULTANEOUS=True, jax_hungry_geese): observe ->
+    (N, P, ...) per-player views, step((N, P) actions), acting -> (N, P)
+    mask of players that act this ply.
 
 This is the throughput ceiling path: on a TPU the per-ply cost is one fused
 program dispatch regardless of N.
@@ -15,7 +23,6 @@ program dispatch regardless of N.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, List
 
 import jax
@@ -26,13 +33,14 @@ from .ops.batch import compress_moments
 from .utils.tree import map_structure
 
 
-class DeviceGenerator:
-    """Runs chunks of device-resident self-play for a pure-JAX env module.
+def _blank(players):
+    return {key: {p: None for p in players} for key in
+            ('observation', 'selected_prob', 'action_mask', 'action',
+             'value', 'reward', 'return')}
 
-    env_mod must expose: init_state(n), observe(state), legal_mask(state),
-    step(state, actions), terminal(state), turn(state), outcome(state),
-    auto_reset(state, done), NUM_PLAYERS, N_ACTIONS.
-    """
+
+class DeviceGenerator:
+    """Runs chunks of device-resident self-play for a pure-JAX env module."""
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
                  n_envs: int = 256, chunk_steps: int = 16, seed: int = 0):
@@ -41,35 +49,61 @@ class DeviceGenerator:
         self.args = args
         self.n_envs = n_envs
         self.chunk_steps = chunk_steps
-        self.state = env_mod.init_state(n_envs)
+        self.simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+        try:
+            self.state = env_mod.init_state(n_envs, seed)
+        except TypeError:
+            self.state = env_mod.init_state(n_envs)
         self.rng = jax.random.PRNGKey(seed)
         self._partials: List[List[dict]] = [[] for _ in range(n_envs)]
 
         apply_fn = wrapper.module.apply
+        simultaneous = self.simultaneous
 
-        @partial(jax.jit, static_argnums=())
+        @jax.jit
         def rollout(params, state, rng):
             def body(carry, _):
                 state, rng = carry
                 obs = env_mod.observe(state)
-                out = apply_fn(params, obs, None)
-                legal = env_mod.legal_mask(state)
-                amask = (1.0 - legal) * 1e32
-                logits = out['policy'] - amask
-                rng, key = jax.random.split(rng)
-                actions = jax.random.categorical(key, logits)
-                probs = jax.nn.softmax(logits, axis=-1)
-                sel_prob = jnp.take_along_axis(
-                    probs, actions[:, None], axis=-1)[:, 0]
-                player = env_mod.turn(state)
-                nstate = env_mod.step(state, actions)
-                done = env_mod.terminal(nstate)
-                record = {
-                    'obs': obs, 'action': actions, 'prob': sel_prob,
-                    'amask': amask, 'value': out.get('value'),
-                    'player': player, 'done': done,
-                    'outcome': env_mod.outcome(nstate),
-                }
+                if simultaneous:
+                    N, P = obs.shape[:2]
+                    flat = obs.reshape((N * P,) + obs.shape[2:])
+                    out = apply_fn(params, flat, None)
+                    legal = env_mod.legal_mask(state)          # (N, P, A)
+                    amask = (1.0 - legal) * 1e32
+                    logits = out['policy'].reshape(N, P, -1) - amask
+                    rng, key = jax.random.split(rng)
+                    actions = jax.random.categorical(key, logits)
+                    probs = jax.nn.softmax(logits, axis=-1)
+                    sel = jnp.take_along_axis(probs, actions[..., None],
+                                              axis=-1)[..., 0]
+                    value = out.get('value')
+                    if value is not None:
+                        value = value.reshape(N, P, -1)
+                    act_mask = env_mod.acting(state)           # (N, P)
+                    nstate = env_mod.step(state, actions)
+                    done = env_mod.terminal(nstate)
+                    record = {'obs': obs, 'action': actions, 'prob': sel,
+                              'amask': amask, 'value': value,
+                              'acting': act_mask, 'done': done,
+                              'outcome': env_mod.outcome(nstate)}
+                else:
+                    out = apply_fn(params, obs, None)
+                    legal = env_mod.legal_mask(state)          # (N, A)
+                    amask = (1.0 - legal) * 1e32
+                    logits = out['policy'] - amask
+                    rng, key = jax.random.split(rng)
+                    actions = jax.random.categorical(key, logits)
+                    probs = jax.nn.softmax(logits, axis=-1)
+                    sel = jnp.take_along_axis(probs, actions[:, None],
+                                              axis=-1)[:, 0]
+                    player = env_mod.turn(state)
+                    nstate = env_mod.step(state, actions)
+                    done = env_mod.terminal(nstate)
+                    record = {'obs': obs, 'action': actions, 'prob': sel,
+                              'amask': amask, 'value': out.get('value'),
+                              'player': player, 'done': done,
+                              'outcome': env_mod.outcome(nstate)}
                 nstate = env_mod.auto_reset(nstate, done)
                 return (nstate, rng), record
 
@@ -79,47 +113,69 @@ class DeviceGenerator:
 
         self._rollout = rollout
 
+    # -- host-side episode splicing ---------------------------------------
     def step_chunk(self) -> List[dict]:
         """Run one compiled chunk; return episodes completed within it."""
         self.state, self.rng, records = self._rollout(
             self.wrapper.params, self.state, self.rng)
-        rec = map_structure(np.asarray, dict(records))
-
+        rec = map_structure(lambda v: None if v is None else np.asarray(v),
+                            dict(records))
         players = list(range(self.env_mod.NUM_PLAYERS))
-        episodes = []
+        episodes: List[dict] = []
         for k in range(self.chunk_steps):
             for i in range(self.n_envs):
-                player = int(rec['player'][k, i])
-                moment = {key: {p: None for p in players} for key in
-                          ('observation', 'selected_prob', 'action_mask',
-                           'action', 'value', 'reward', 'return')}
-                moment['observation'][player] = rec['obs'][k, i]
-                moment['selected_prob'][player] = float(rec['prob'][k, i])
-                moment['action_mask'][player] = rec['amask'][k, i]
-                moment['action'][player] = int(rec['action'][k, i])
-                if rec.get('value') is not None:
-                    moment['value'][player] = rec['value'][k, i]
-                moment['reward'] = {p: None for p in players}
-                moment['turn'] = [player]
+                if self.simultaneous:
+                    moment = self._moment_simultaneous(rec, k, i, players)
+                else:
+                    moment = self._moment_turn_based(rec, k, i, players)
                 self._partials[i].append(moment)
-
                 if rec['done'][k, i]:
-                    moments = self._partials[i]
-                    self._partials[i] = []
-                    outcome = {p: float(rec['outcome'][k, i, p])
-                               for p in players}
-                    for p in players:
-                        ret = 0.0
-                        for t in range(len(moments) - 1, -1, -1):
-                            ret = (moments[t]['reward'][p] or 0) \
-                                + self.args['gamma'] * ret
-                            moments[t]['return'][p] = ret
-                    episodes.append({
-                        'args': {'role': 'g', 'player': players,
-                                 'model_id': {p: -1 for p in players}},
-                        'steps': len(moments),
-                        'outcome': outcome,
-                        'moment': compress_moments(
-                            moments, self.args['compress_steps']),
-                    })
+                    episodes.append(self._finalize(i, rec, k, players))
         return episodes
+
+    def _moment_turn_based(self, rec, k, i, players):
+        player = int(rec['player'][k, i])
+        moment = _blank(players)
+        moment['observation'][player] = rec['obs'][k, i]
+        moment['selected_prob'][player] = float(rec['prob'][k, i])
+        moment['action_mask'][player] = rec['amask'][k, i]
+        moment['action'][player] = int(rec['action'][k, i])
+        if rec.get('value') is not None:
+            moment['value'][player] = rec['value'][k, i]
+        moment['reward'] = {p: None for p in players}
+        moment['turn'] = [player]
+        return moment
+
+    def _moment_simultaneous(self, rec, k, i, players):
+        moment = _blank(players)
+        turn_players = []
+        for p in players:
+            if not rec['acting'][k, i, p]:
+                continue
+            turn_players.append(p)
+            moment['observation'][p] = rec['obs'][k, i, p]
+            moment['selected_prob'][p] = float(rec['prob'][k, i, p])
+            moment['action_mask'][p] = rec['amask'][k, i, p]
+            moment['action'][p] = int(rec['action'][k, i, p])
+            if rec.get('value') is not None:
+                moment['value'][p] = rec['value'][k, i, p]
+        moment['reward'] = {p: None for p in players}
+        moment['turn'] = turn_players
+        return moment
+
+    def _finalize(self, i, rec, k, players):
+        moments = self._partials[i]
+        self._partials[i] = []
+        outcome = {p: float(rec['outcome'][k, i, p]) for p in players}
+        for p in players:
+            ret = 0.0
+            for t in range(len(moments) - 1, -1, -1):
+                ret = (moments[t]['reward'][p] or 0) + self.args['gamma'] * ret
+                moments[t]['return'][p] = ret
+        return {
+            'args': {'role': 'g', 'player': players,
+                     'model_id': {p: -1 for p in players}},
+            'steps': len(moments),
+            'outcome': outcome,
+            'moment': compress_moments(moments, self.args['compress_steps']),
+        }

@@ -29,6 +29,7 @@ ENVS = {
 # fully device-resident rollouts (device_generation.py).
 JAX_ENVS = {
     'TicTacToe': 'handyrl_tpu.envs.jax_tictactoe',
+    'HungryGeese': 'handyrl_tpu.envs.jax_hungry_geese',
 }
 
 

@@ -1,0 +1,270 @@
+"""Pure-JAX vectorized Hungry Geese: the flagship env as jittable array
+functions (device-resident twin of envs/kaggle/hungry_geese.py).
+
+N games of 4 geese advance as one program. Bodies are fixed-size ordered
+cell buffers (head at index 0) with explicit lengths; movement is a shift,
+growth/starvation are length edits, collisions are scatter-counts on the
+7x11 board, and food respawn is a categorical draw over empty cells — no
+data-dependent shapes anywhere.
+
+Simultaneous-move protocol for device_generation.DeviceGenerator:
+``SIMULTANEOUS = True``, ``observe`` returns per-player planes
+(N, P, 17, 7, 11), ``step`` consumes (N, P) actions, ``acting`` gives the
+per-player act mask.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+R, C = 7, 11
+N_CELLS = R * C
+NUM_PLAYERS = 4
+N_ACTIONS = 4
+MAX_LEN = N_CELLS
+HUNGER_RATE = 40
+MAX_STEPS = 200
+N_FOOD = 2
+MAX_LEN_SCORE = N_CELLS + 1
+SIMULTANEOUS = True
+
+# NORTH, SOUTH, WEST, EAST — row/col deltas and the opposite-action table
+DROW = jnp.array([-1, 1, 0, 0], jnp.int32)
+DCOL = jnp.array([0, 0, -1, 1], jnp.int32)
+OPPOSITE = jnp.array([1, 0, 3, 2], jnp.int32)
+
+
+class State(NamedTuple):
+    cells: jnp.ndarray       # (N, P, MAX_LEN) ordered cell ids, head first
+    length: jnp.ndarray      # (N, P) int32; 0 = gone
+    alive: jnp.ndarray       # (N, P) bool
+    food: jnp.ndarray        # (N, N_FOOD) int32 cell ids
+    last_action: jnp.ndarray  # (N, P) int32; -1 = none yet
+    prev_heads: jnp.ndarray  # (N, P) int32; -1 = none
+    steps: jnp.ndarray       # (N,) int32
+    scores: jnp.ndarray      # (N, P) float32
+    key: jnp.ndarray         # (N, 2) per-env PRNG keys (uint32)
+
+
+def _move_cells(cells: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    r, c = cells // C, cells % C
+    return ((r + DROW[actions]) % R) * C + (c + DCOL[actions]) % C
+
+
+def _spawn(key, occupied_mask):
+    """Sample one cell uniformly from unoccupied cells. occupied_mask (77,)."""
+    logits = jnp.where(occupied_mask, -jnp.inf, 0.0)
+    return jax.random.categorical(key, logits)
+
+
+def init_state(n: int, seed: int = 0) -> State:
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+
+    def init_one(key):
+        picks = jax.random.choice(key, N_CELLS, (NUM_PLAYERS + N_FOOD,),
+                                  replace=False)
+        cells = jnp.full((NUM_PLAYERS, MAX_LEN), -1, jnp.int32)
+        cells = cells.at[:, 0].set(picks[:NUM_PLAYERS].astype(jnp.int32))
+        return cells, picks[NUM_PLAYERS:].astype(jnp.int32)
+
+    cells, food = jax.vmap(init_one)(keys)
+    n_arr = jnp.arange(n)
+    del n_arr
+    state = State(
+        cells=cells,
+        length=jnp.ones((n, NUM_PLAYERS), jnp.int32),
+        alive=jnp.ones((n, NUM_PLAYERS), bool),
+        food=food,
+        last_action=jnp.full((n, NUM_PLAYERS), -1, jnp.int32),
+        prev_heads=jnp.full((n, NUM_PLAYERS), -1, jnp.int32),
+        steps=jnp.zeros((n,), jnp.int32),
+        scores=jnp.zeros((n, NUM_PLAYERS), jnp.float32),
+        key=jax.vmap(jax.random.fold_in)(keys, jnp.arange(n)),
+    )
+    return state._replace(scores=_scores(state))
+
+
+def _scores(state: State) -> jnp.ndarray:
+    live_score = ((state.steps[:, None] + 1) * MAX_LEN_SCORE
+                  + state.length).astype(jnp.float32)
+    return jnp.where(state.alive, live_score, state.scores)
+
+
+def acting(state: State) -> jnp.ndarray:
+    """(N, P) bool: which players submit actions this step."""
+    return state.alive
+
+
+def terminal(state: State) -> jnp.ndarray:
+    return (state.alive.sum(axis=1) <= 1) | (state.steps >= MAX_STEPS)
+
+
+def legal_mask(state: State) -> jnp.ndarray:
+    """(N, P, A) — all actions submittable (reference parity)."""
+    n = state.cells.shape[0]
+    return jnp.ones((n, NUM_PLAYERS, N_ACTIONS), jnp.float32)
+
+
+def outcome(state: State) -> jnp.ndarray:
+    """Pairwise-rank score in {-1..1}, (N, P)."""
+    s = state.scores
+    beats = (s[:, :, None] > s[:, None, :]).sum(axis=2).astype(jnp.float32)
+    loses = (s[:, :, None] < s[:, None, :]).sum(axis=2).astype(jnp.float32)
+    return (beats - loses) / (NUM_PLAYERS - 1)
+
+
+def _body_occupancy(cells, length, alive, include_heads):
+    """Scatter-count occupied cells -> (N, 77) counts."""
+    idx = jnp.arange(MAX_LEN)[None, None, :]
+    start = 0 if include_heads else 1
+    valid = (idx >= start) & (idx < length[..., None]) & alive[..., None]
+    flat = jnp.where(valid, cells, N_CELLS)   # out-of-range bucket
+    one_hot = jax.nn.one_hot(flat, N_CELLS + 1, dtype=jnp.float32)
+    return one_hot.sum(axis=(1, 2))[:, :N_CELLS]
+
+
+def step(state: State, actions: jnp.ndarray) -> State:
+    """Apply (N, P) actions; dead players' actions are ignored."""
+    prev_heads = jnp.where(state.alive, state.cells[:, :, 0], -1)
+
+    # 1. reversal deaths (only with a body to reverse onto)
+    reversed_ = (state.last_action >= 0) & \
+        (actions == OPPOSITE[jnp.clip(state.last_action, 0, 3)]) & \
+        (state.length > 1)
+    alive = state.alive & ~reversed_
+
+    # 2. move heads, eat
+    heads = state.cells[:, :, 0]
+    new_heads = _move_cells(heads, actions)
+    ate = (new_heads[:, :, None] == state.food[:, None, :]).any(axis=2) & alive
+    cells = jnp.concatenate([new_heads[:, :, None], state.cells[:, :, :-1]],
+                            axis=2)
+    length = state.length + ate.astype(jnp.int32)
+
+    # 3. starvation every HUNGER_RATE steps
+    steps = state.steps + 1
+    starve = (steps % HUNGER_RATE == 0)
+    length = length - (starve[:, None] & alive).astype(jnp.int32)
+    starved = alive & (length <= 0)
+    alive = alive & (length > 0)
+
+    # 4. collisions on the post-move board
+    body_occ = _body_occupancy(cells, length, alive, include_heads=False)
+    head_cell = cells[:, :, 0]
+    head_onehot = jax.nn.one_hot(jnp.where(alive, head_cell, N_CELLS),
+                                 N_CELLS + 1, dtype=jnp.float32)
+    head_count = head_onehot.sum(axis=1)[:, :N_CELLS]
+    hits_body = jnp.take_along_axis(body_occ, head_cell, axis=1) > 0
+    head_clash = jnp.take_along_axis(head_count, head_cell, axis=1) > 1
+    collided = alive & (hits_body | head_clash)
+    alive = alive & ~collided
+
+    length = jnp.where(alive, length, 0)
+
+    # freeze scores of the newly dead at their pre-death value; update alive
+    dead_now = state.alive & ~alive
+    frozen = jnp.where(dead_now, state.scores, 0.0)
+    live_score = ((steps[:, None] + 1) * MAX_LEN_SCORE + length).astype(jnp.float32)
+    scores = jnp.where(alive, live_score,
+                       jnp.where(dead_now, frozen, state.scores))
+
+    # 5. food respawn for eaten slots (uniform over empty cells)
+    occupied = _body_occupancy(cells, length, alive, include_heads=True) > 0
+    # slot f was eaten if any goose that ate has its new head on that cell
+    food_eaten = ((state.food[:, None, :] == new_heads[:, :, None])
+                  & ate[:, :, None]).any(axis=1)            # (N, N_FOOD)
+
+    def respawn_env(key, food, eaten, occ):
+        def one(i, carry):
+            key, food = carry
+            key, sub = jax.random.split(key)
+            occ_now = occ | jax.nn.one_hot(food, N_CELLS, dtype=bool).any(axis=0)
+            new_cell = _spawn(sub, occ_now)
+            food = food.at[i].set(jnp.where(eaten[i], new_cell, food[i]))
+            return key, food
+        key, food = jax.lax.fori_loop(0, N_FOOD, one, (key, food))
+        return key, food
+
+    key, food = jax.vmap(respawn_env)(state.key, state.food, food_eaten,
+                                      occupied)
+
+    last_action = jnp.where(state.alive, actions, state.last_action)
+
+    return State(cells=cells, length=length, alive=alive, food=food,
+                 last_action=last_action, prev_heads=prev_heads,
+                 steps=steps, scores=scores, key=key)
+
+
+def observe(state: State) -> jnp.ndarray:
+    """Per-player observation planes (N, P, 17, 7, 11), channel layout and
+    relative player rotation exactly as the host env (hungry_geese.py
+    observation): heads, tails, bodies, previous heads, food."""
+    n = state.cells.shape[0]
+    idx = jnp.arange(MAX_LEN)[None, None, :]
+    valid = (idx < state.length[..., None]) & state.alive[..., None]
+    flat = jnp.where(valid, state.cells, N_CELLS)
+    body_planes = jax.nn.one_hot(flat, N_CELLS + 1,
+                                 dtype=jnp.float32).sum(axis=2)[..., :N_CELLS]
+    body_planes = jnp.minimum(body_planes, 1.0)            # (N, P, 77)
+
+    head = jnp.where(state.alive, state.cells[:, :, 0], N_CELLS)
+    head_planes = jax.nn.one_hot(head, N_CELLS + 1,
+                                 dtype=jnp.float32)[..., :N_CELLS]
+    tail_idx = jnp.clip(state.length - 1, 0, MAX_LEN - 1)
+    tail = jnp.take_along_axis(state.cells, tail_idx[..., None], axis=2)[..., 0]
+    tail = jnp.where(state.alive, tail, N_CELLS)
+    tail_planes = jax.nn.one_hot(tail, N_CELLS + 1,
+                                 dtype=jnp.float32)[..., :N_CELLS]
+    prev = jnp.where(state.prev_heads >= 0, state.prev_heads, N_CELLS)
+    prev_planes = jax.nn.one_hot(prev, N_CELLS + 1,
+                                 dtype=jnp.float32)[..., :N_CELLS]
+    food_plane = jax.nn.one_hot(state.food, N_CELLS,
+                                dtype=jnp.float32).sum(axis=1)  # (N, 77)
+
+    # relative rotation: viewer p sees goose q in channel (q - p) % P
+    def planes_for(viewer):
+        order = (jnp.arange(NUM_PLAYERS) + viewer) % NUM_PLAYERS
+        return jnp.concatenate([
+            head_planes[:, order], tail_planes[:, order],
+            body_planes[:, order], prev_planes[:, order],
+            food_plane[:, None, :],
+        ], axis=1)                                          # (N, 17, 77)
+
+    obs = jnp.stack([planes_for(p) for p in range(NUM_PLAYERS)], axis=1)
+    return obs.reshape(n, NUM_PLAYERS, 17, R, C)
+
+
+def auto_reset(state: State, done: jnp.ndarray) -> State:
+    n = state.cells.shape[0]
+    keys = jax.vmap(lambda k: jax.random.split(k)[0])(state.key)
+
+    def fresh_one(key):
+        picks = jax.random.choice(key, N_CELLS, (NUM_PLAYERS + N_FOOD,),
+                                  replace=False)
+        cells = jnp.full((NUM_PLAYERS, MAX_LEN), -1, jnp.int32)
+        cells = cells.at[:, 0].set(picks[:NUM_PLAYERS].astype(jnp.int32))
+        return cells, picks[NUM_PLAYERS:].astype(jnp.int32)
+
+    f_cells, f_food = jax.vmap(fresh_one)(keys)
+    ones = jnp.ones((n, NUM_PLAYERS), jnp.int32)
+    f_scores = (1 * MAX_LEN_SCORE + ones).astype(jnp.float32)
+
+    def pick(fresh, cur):
+        return jnp.where(done.reshape((-1,) + (1,) * (cur.ndim - 1)), fresh, cur)
+
+    return State(
+        cells=pick(f_cells, state.cells),
+        length=pick(ones, state.length),
+        alive=pick(jnp.ones((n, NUM_PLAYERS), bool), state.alive),
+        food=pick(f_food, state.food),
+        last_action=pick(jnp.full((n, NUM_PLAYERS), -1, jnp.int32),
+                         state.last_action),
+        prev_heads=pick(jnp.full((n, NUM_PLAYERS), -1, jnp.int32),
+                        state.prev_heads),
+        steps=pick(jnp.zeros((n,), jnp.int32), state.steps),
+        scores=pick(f_scores, state.scores),
+        key=keys,
+    )

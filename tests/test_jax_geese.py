@@ -1,0 +1,180 @@
+"""Pure-JAX Hungry Geese: rule scenarios vs the host simulator, rollout
+invariants, and device-resident generation through the batch builder."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from handyrl_tpu.envs import jax_hungry_geese as jhg
+from handyrl_tpu.envs.kaggle.hungry_geese import Environment as HostGeese
+from handyrl_tpu.device_generation import DeviceGenerator
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.models import build
+from handyrl_tpu.ops.batch import decompress_moments, make_batch, select_episode
+from helpers import train_args
+
+
+def _manual_state(geese, food, last_actions=None, steps=0):
+    """Build a 1-env device state from explicit goose cell lists."""
+    n = 1
+    cells = np.full((n, 4, jhg.MAX_LEN), -1, np.int32)
+    length = np.zeros((n, 4), np.int32)
+    alive = np.zeros((n, 4), bool)
+    for p, goose in enumerate(geese):
+        for j, cell in enumerate(goose):
+            cells[0, p, j] = cell
+        length[0, p] = len(goose)
+        alive[0, p] = len(goose) > 0
+    la = np.full((n, 4), -1, np.int32)
+    for p, a in (last_actions or {}).items():
+        la[0, p] = a
+    state = jhg.State(
+        cells=jnp.asarray(cells), length=jnp.asarray(length),
+        alive=jnp.asarray(alive), food=jnp.asarray([food], jnp.int32),
+        last_action=jnp.asarray(la),
+        prev_heads=jnp.full((n, 4), -1, jnp.int32),
+        steps=jnp.asarray([steps], jnp.int32),
+        scores=jnp.zeros((n, 4), jnp.float32), key=jax.random.split(jax.random.PRNGKey(0), 1),
+    )
+    return state._replace(scores=((state.steps[:, None] + 1) * jhg.MAX_LEN_SCORE
+                                  + state.length).astype(jnp.float32)
+                          * state.alive)
+
+
+def _host_with(geese, food, last_actions=None, steps=0):
+    e = HostGeese({})
+    e.geese = [list(g) for g in geese]
+    e.prev_geese = [list(g) for g in geese]
+    e.food = list(food)
+    e.alive = [len(g) > 0 for g in geese]
+    e.last_actions = dict(last_actions or {})
+    e.step_count = steps
+    e.scores = [0.0] * 4
+    e._update_scores()
+    return e
+
+
+SCENARIOS = [
+    # (geese, food, actions, name)
+    ([[0], [20], [40], [60]], [5, 70], {0: 3, 1: 3, 2: 3, 3: 3}, 'all-east'),
+    # goose 0 eats the food at cell 1 (east of 0)
+    ([[0], [20], [40], [60]], [1, 70], {0: 3, 1: 3, 2: 3, 3: 3}, 'eat'),
+    # head-on collision: goose 0 at 0 moves east, goose 1 at 2 moves west
+    ([[0], [2], [40], [60]], [70, 75], {0: 3, 1: 2, 2: 3, 3: 3}, 'head-on'),
+    # goose 0 runs into goose 1's body
+    ([[0], [12, 1, 2], [40], [60]], [70, 75], {0: 3, 1: 1, 2: 3, 3: 3}, 'body-hit'),
+]
+
+
+@pytest.mark.parametrize('geese,food,actions,name',
+                         SCENARIOS, ids=[s[3] for s in SCENARIOS])
+def test_step_matches_host_simulator(geese, food, actions, name):
+    """Deterministic single steps (no food respawn randomness in the checked
+    fields) must agree with the host simulator."""
+    dev = _manual_state(geese, food)
+    host = _host_with(geese, food)
+
+    dev2 = jhg.step(dev, jnp.asarray([[actions[p] for p in range(4)]]))
+    host.step(dict(actions))
+
+    np.testing.assert_array_equal(np.asarray(dev2.alive)[0], host.alive)
+    for p in range(4):
+        L = int(np.asarray(dev2.length)[0, p])
+        host_goose = host.geese[p]
+        assert L == len(host_goose), (name, p)
+        if L:
+            np.testing.assert_array_equal(
+                np.asarray(dev2.cells)[0, p, :L], host_goose)
+
+
+def test_reversal_death_matches_host():
+    geese = [[1, 0], [20], [40], [60]]       # goose 0 heading east (came from 0)
+    dev = _manual_state(geese, [70, 75], last_actions={0: 3})
+    host = _host_with(geese, [70, 75], last_actions={0: 3})
+    actions = {0: 2, 1: 3, 2: 3, 3: 3}       # goose 0 reverses west
+    dev2 = jhg.step(dev, jnp.asarray([[actions[p] for p in range(4)]]))
+    host.step(dict(actions))
+    assert not host.alive[0]
+    assert not bool(np.asarray(dev2.alive)[0, 0])
+
+
+def test_starvation_matches_host():
+    geese = [[0], [20], [40], [60]]
+    dev = _manual_state(geese, [70, 75], steps=jhg.HUNGER_RATE - 1)
+    host = _host_with(geese, [70, 75], steps=jhg.HUNGER_RATE - 1)
+    actions = {p: 3 for p in range(4)}
+    dev2 = jhg.step(dev, jnp.asarray([[3, 3, 3, 3]]))
+    host.step(actions)
+    # everyone starved at length 1
+    assert host.alive == [False] * 4
+    assert not np.asarray(dev2.alive)[0].any()
+
+
+def test_random_rollout_invariants():
+    state = jhg.init_state(8, seed=1)
+    key = jax.random.PRNGKey(2)
+    for _ in range(60):
+        key, k = jax.random.split(key)
+        actions = jax.random.randint(k, (8, 4), 0, 4)
+        state = jhg.step(state, actions)
+        state = jhg.auto_reset(state, jhg.terminal(state))
+        lengths = np.asarray(state.length)
+        alive = np.asarray(state.alive)
+        assert (lengths[alive] >= 1).all()
+        assert (lengths[~alive] == 0).all()
+        # no two living geese overlap
+        cells = np.asarray(state.cells)
+        for i in range(8):
+            occ = []
+            for p in range(4):
+                if alive[i, p]:
+                    occ += list(cells[i, p, :lengths[i, p]])
+            assert len(occ) == len(set(occ))
+        # food cells are distinct and unoccupied
+        food = np.asarray(state.food)
+        for i in range(8):
+            assert len(set(food[i])) == jhg.N_FOOD
+
+
+def test_observation_matches_host_layout():
+    geese = [[0, 11], [20], [], [60]]
+    dev = _manual_state(geese, [5, 70])
+    host = _host_with(geese, [5, 70])
+    obs_dev = np.asarray(jhg.observe(dev))[0]          # (P, 17, 7, 11)
+    for viewer in range(4):
+        want = host.observation(viewer)
+        # prev-head channels: host uses prev_geese (= current here after our
+        # manual construction both have no prev step); device has none
+        got = obs_dev[viewer].copy()
+        got[12:16] = want[12:16]                        # neutralize prev-head
+        np.testing.assert_array_equal(got, want)
+
+
+def test_device_generator_simultaneous_episodes():
+    wrapper = ModelWrapper(build('GeeseNet', layers=2, filters=16))
+    wrapper.ensure_params(np.zeros((17, 7, 11), np.float32))
+    args = train_args(forward_steps=8, turn_based=False, observation=True)
+    args['gamma'] = 0.99
+    gen = DeviceGenerator(jhg, wrapper, args, n_envs=8, chunk_steps=16, seed=3)
+
+    episodes = []
+    for _ in range(10):
+        episodes += gen.step_chunk()
+        if len(episodes) >= 4:
+            break
+    assert len(episodes) >= 4
+
+    ep = episodes[0]
+    moments = decompress_moments(ep['moment'])
+    assert len(moments) == ep['steps']
+    m0 = moments[0]
+    assert len(m0['turn']) == 4                       # everyone acts at start
+    assert m0['observation'][0].shape == (17, 7, 11)
+    total = sum(ep['outcome'].values())
+    assert abs(total) < 1e-6                          # rank outcomes sum to 0
+
+    batch = make_batch([select_episode(episodes, args) for _ in range(4)], args)
+    # solo training: one random seat per window
+    assert batch['observation'].shape[:3] == (4, 8, 1)
+    assert np.isfinite(np.asarray(batch['selected_prob'])).all()
