@@ -10,6 +10,7 @@ architecture name — never as pickled code.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict
 
 import jax
@@ -25,6 +26,14 @@ def _to_numpy(x):
     return jax.tree_util.tree_map(np.asarray, x)
 
 
+@functools.lru_cache(maxsize=64)
+def _jitted_apply(module):
+    """One jit per module configuration (flax modules hash by their fields),
+    shared across wrapper instances — workers re-fetching params every epoch
+    reuse the compiled program instead of re-tracing."""
+    return jax.jit(module.apply)
+
+
 class ModelWrapper:
     """Holds (module, params); provides jitted single/batched inference."""
 
@@ -32,12 +41,7 @@ class ModelWrapper:
         self.module = module
         self.params = params
         self.seed = seed
-
-        @jax.jit
-        def _apply(params, obs, hidden):
-            return self.module.apply(params, obs, hidden)
-
-        self._apply = _apply
+        self._apply = _jitted_apply(module)
 
     # -- params lifecycle -------------------------------------------------
     def ensure_params(self, example_obs) -> None:

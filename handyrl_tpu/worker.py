@@ -44,6 +44,7 @@ class Worker:
         self.args = args
         self.conn = conn
         self.model_pool: Dict[int, Any] = {}
+        self._arch_wrappers: Dict[str, ModelWrapper] = {}
 
         self.env = make_env({**args['env'], 'id': wid})
         self.generator = Generator(self.env, self.args)
@@ -63,14 +64,23 @@ class Worker:
             if model_id is None or model_id < 0 or model_id in self.model_pool:
                 continue
             snap = send_recv(self.conn, ('model', model_id))
-            wrapper = ModelWrapper.from_snapshot(snap, self._example_obs())
+            # reuse one wrapper per architecture: loading new params into it
+            # keeps the compiled apply and the param template across epochs
+            arch = snap['architecture']
+            wrapper = self._arch_wrappers.get(arch)
+            if wrapper is None:
+                wrapper = ModelWrapper.from_snapshot(snap, self._example_obs())
+                self._arch_wrappers[arch] = wrapper
+            else:
+                wrapper.load_params_bytes(snap['params'], self._example_obs())
+            model = wrapper
             if model_id == 0:
                 # epoch 0 means an untrained net: play uniformly at random
-                wrapper = RandomModel(wrapper, self._example_obs())
+                model = RandomModel(wrapper, self._example_obs())
             # single-slot cache: evict the oldest entry
             if len(self.model_pool) >= 1:
                 self.model_pool.pop(next(iter(self.model_pool)))
-            self.model_pool[model_id] = wrapper
+            self.model_pool[model_id] = model
 
     def run(self):
         while True:
