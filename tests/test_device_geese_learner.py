@@ -1,0 +1,26 @@
+"""Learner end to end with device-resident Hungry Geese generation."""
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.models import build
+from handyrl_tpu.train import Learner
+
+
+def test_geese_device_learner_one_epoch(tmp_path):
+    raw = {
+        'env_args': {'env': 'HungryGeese'},
+        'train_args': {
+            'turn_based_training': False, 'observation': True,
+            'gamma': 0.99, 'forward_steps': 8, 'compress_steps': 4,
+            'batch_size': 8, 'update_episodes': 10, 'minimum_episodes': 10,
+            'epochs': 1, 'generation_envs': 8, 'num_batchers': 1,
+            'device_generation': True,
+            'policy_target': 'VTRACE', 'value_target': 'VTRACE',
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    args = apply_defaults(raw)
+    learner = Learner(args=args, net=build('GeeseNet', layers=2, filters=16))
+    learner.run()
+    assert learner.model_epoch == 1
+    assert learner.num_returned_episodes >= 10
+    assert (tmp_path / 'models' / '1.ckpt').exists()
