@@ -84,8 +84,12 @@ class Batcher:
 
     def _selector(self):
         while True:
-            selected = [select_episode(self.episodes, self.args)
-                        for _ in range(self.args['batch_size'])]
+            try:
+                selected = [select_episode(self.episodes, self.args)
+                            for _ in range(self.args['batch_size'])]
+            except (IndexError, ValueError):   # buffer transiently empty
+                time.sleep(0.1)
+                continue
             # strip non-picklable/irrelevant entries from the job payload
             job_args = {k: v for k, v in self.args.items()
                         if k in ('turn_based_training', 'observation',
