@@ -1,0 +1,79 @@
+"""HBM-resident replay buffer: training windows stored and sampled on device.
+
+The host-side pipeline (episode deque -> select_episode -> make_batch)
+decompresses and re-pads windows on every SGD step. For device-generation
+runs this buffer removes that host work from the steady state: fixed-shape
+training windows are pushed to device once, live in HBM as a ring, and batch
+assembly is a gather by random indices inside jit — the sampled batch never
+touches the host.
+
+Recency bias matches the reference sampler (train.py:291-297): index i of n
+buffered windows is drawn with probability proportional to (i+1) (newest
+most likely), implemented as a closed-form inverse-CDF on device.
+
+Windows are dicts of arrays shaped (T, P, ...) exactly as ops/batch.py
+builds them; ``sample`` returns the same (B, T, P, ...) batch dict the
+update step consumes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class DeviceReplay:
+    """Fixed-capacity ring of training windows in device memory."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.buffers: Dict[str, Any] = {}
+        self.cursor = 0
+        self.size = 0
+
+        @jax.jit
+        def _write(buffers, windows, cursor):
+            n = jax.tree_util.tree_leaves(windows)[0].shape[0]
+            idx = (cursor + jnp.arange(n)) % self.capacity
+
+            def put(buf, win):
+                return buf.at[idx].set(win)
+
+            return jax.tree_util.tree_map(put, buffers, windows)
+
+        @partial(jax.jit, static_argnames=('batch_size',))
+        def _sample(buffers, key, size, cursor, batch_size):
+            # recency-biased index draw: P(i) ~ (i+1) for i in [0, size)
+            # inverse CDF of the triangular weighting: i = floor(sqrt(u)*size)
+            u = jax.random.uniform(key, (batch_size,))
+            recency = jnp.sqrt(u)
+            idx = jnp.minimum((recency * size).astype(jnp.int32), size - 1)
+            # ring order: oldest window sits at cursor when full
+            start = jnp.where(size >= self.capacity, cursor, 0)
+            slots = (start + idx) % self.capacity
+            return jax.tree_util.tree_map(lambda b: b[slots], buffers)
+
+        self._write_fn = _write
+        self._sample_fn = _sample
+
+    def push(self, windows: Dict[str, Any]):
+        """Append a stack of windows (leading axis = window count)."""
+        n = jax.tree_util.tree_leaves(windows)[0].shape[0]
+        if not self.buffers:
+            def alloc(win):
+                return jnp.zeros((self.capacity,) + win.shape[1:], win.dtype)
+            self.buffers = jax.tree_util.tree_map(alloc, windows)
+        self.buffers = self._write_fn(self.buffers, windows,
+                                      jnp.asarray(self.cursor, jnp.int32))
+        self.cursor = (self.cursor + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, key, batch_size: int) -> Dict[str, Any]:
+        assert self.size > 0, 'sampling from an empty replay buffer'
+        return self._sample_fn(self.buffers, key,
+                               jnp.asarray(self.size, jnp.int32),
+                               jnp.asarray(self.cursor, jnp.int32),
+                               batch_size)
