@@ -1,0 +1,27 @@
+"""bfloat16 compute path: learner trains with compute_dtype='bfloat16'
+(params stay float32, activations bf16 — the MXU-friendly mode)."""
+
+import jax
+import numpy as np
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.train import Learner
+
+
+def test_learner_bf16_compute(tmp_path):
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 8, 'update_episodes': 20, 'minimum_episodes': 20,
+            'epochs': 1, 'generation_envs': 8, 'forward_steps': 8,
+            'num_batchers': 1, 'compute_dtype': 'bfloat16',
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    assert learner.wrapper.module.dtype == jax.numpy.bfloat16
+    # params remain float32
+    leaf = jax.tree_util.tree_leaves(learner.wrapper.params)[0]
+    assert leaf.dtype == np.float32
+    learner.run()
+    assert learner.model_epoch == 1
