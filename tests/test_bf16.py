@@ -25,3 +25,6 @@ def test_learner_bf16_compute(tmp_path):
     assert leaf.dtype == np.float32
     learner.run()
     assert learner.model_epoch == 1
+    # after training + checkpointing, every param leaf is still float32
+    for leaf in jax.tree_util.tree_leaves(learner.wrapper.params):
+        assert leaf.dtype == np.float32, leaf.dtype
