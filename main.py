@@ -18,6 +18,13 @@ modes:
 
 
 def main():
+    import os
+    if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':
+        # the axon TPU site hook overrides the env var via jax config at
+        # import; honor an explicit CPU request anyway
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+
     args = load_config('config.yaml')
     print(args)
 

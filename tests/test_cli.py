@@ -1,0 +1,45 @@
+"""CLI end to end: `python main.py --train` then `--eval` as real processes
+with a user-style config.yaml."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+CONFIG = """
+env_args:
+    env: 'TicTacToe'
+
+train_args:
+    batch_size: 8
+    forward_steps: 8
+    update_episodes: 15
+    minimum_episodes: 15
+    epochs: 1
+    generation_envs: 8
+    num_batchers: 1
+"""
+
+
+@pytest.mark.timeout(600)
+def test_cli_train_then_eval(tmp_path):
+    (tmp_path / 'config.yaml').write_text(CONFIG)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
+           'PYTHONPATH': repo + os.pathsep + os.environ.get('PYTHONPATH', '')}
+
+    train = subprocess.run(
+        [sys.executable, os.path.join(repo, 'main.py'), '--train'],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=300)
+    assert train.returncode == 0, train.stdout[-2000:] + train.stderr[-2000:]
+    assert 'updated model(' in train.stdout
+    assert (tmp_path / 'models' / 'latest.ckpt').exists()
+
+    ev = subprocess.run(
+        [sys.executable, os.path.join(repo, 'main.py'), '--eval',
+         'models/latest.ckpt', '4', '1'],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=240)
+    assert ev.returncode == 0, ev.stdout[-2000:] + ev.stderr[-2000:]
+    assert 'total games = 4' in ev.stdout
+    assert '---agent 0---' in ev.stdout
