@@ -33,6 +33,11 @@ from .ops.batch import compress_moments
 from .utils.tree import map_structure
 
 
+def obs_leading(obs) -> int:
+    """Leading (env) dimension of an observation pytree."""
+    return jax.tree_util.tree_leaves(obs)[0].shape[0]
+
+
 def _blank(players):
     return {key: {p: None for p in players} for key in
             ('observation', 'selected_prob', 'action_mask', 'action',
@@ -59,11 +64,17 @@ class DeviceGenerator:
 
         apply_fn = wrapper.module.apply
         simultaneous = self.simultaneous
+        recurrent = hasattr(wrapper.module, 'init_hidden')
+        if recurrent and simultaneous:
+            raise NotImplementedError(
+                'recurrent device generation is turn-based only for now')
+        self.hidden = (wrapper.module.init_hidden(
+            (n_envs, env_mod.NUM_PLAYERS)) if recurrent else None)
 
         @jax.jit
-        def rollout(params, state, rng):
+        def rollout(params, state, hidden, rng):
             def body(carry, _):
-                state, rng = carry
+                state, hidden, rng = carry
                 obs = env_mod.observe(state)
                 if simultaneous:
                     N, P = obs.shape[:2]
@@ -88,7 +99,20 @@ class DeviceGenerator:
                               'acting': act_mask, 'done': done,
                               'outcome': env_mod.outcome(nstate)}
                 else:
-                    out = apply_fn(params, obs, None)
+                    player = env_mod.turn(state)
+                    if recurrent:
+                        # gather the acting player's hidden slot, run the
+                        # net, scatter the new state back (mirrors the
+                        # omask-gated carry the training scan uses)
+                        rows = jnp.arange(obs_leading(obs))
+                        h_in = jax.tree_util.tree_map(
+                            lambda h: h[rows, player], hidden)
+                        out = dict(apply_fn(params, obs, h_in))
+                        nh = out.pop('hidden')
+                        hidden = jax.tree_util.tree_map(
+                            lambda h, x: h.at[rows, player].set(x), hidden, nh)
+                    else:
+                        out = apply_fn(params, obs, None)
                     legal = env_mod.legal_mask(state)          # (N, A)
                     amask = (1.0 - legal) * 1e32
                     logits = out['policy'] - amask
@@ -97,7 +121,6 @@ class DeviceGenerator:
                     probs = jax.nn.softmax(logits, axis=-1)
                     sel = jnp.take_along_axis(probs, actions[:, None],
                                               axis=-1)[:, 0]
-                    player = env_mod.turn(state)
                     nstate = env_mod.step(state, actions)
                     done = env_mod.terminal(nstate)
                     record = {'obs': obs, 'action': actions, 'prob': sel,
@@ -105,19 +128,25 @@ class DeviceGenerator:
                               'player': player, 'done': done,
                               'outcome': env_mod.outcome(nstate)}
                 nstate = env_mod.auto_reset(nstate, done)
-                return (nstate, rng), record
+                if recurrent:
+                    # fresh episodes start with zero recurrent state
+                    hidden = jax.tree_util.tree_map(
+                        lambda h: jnp.where(
+                            done.reshape((-1,) + (1,) * (h.ndim - 1)),
+                            jnp.zeros_like(h), h), hidden)
+                return (nstate, hidden, rng), record
 
-            (state, rng), records = jax.lax.scan(
-                body, (state, rng), None, length=chunk_steps)
-            return state, rng, records
+            (state, hidden, rng), records = jax.lax.scan(
+                body, (state, hidden, rng), None, length=chunk_steps)
+            return state, hidden, rng, records
 
         self._rollout = rollout
 
     # -- host-side episode splicing ---------------------------------------
     def step_chunk(self) -> List[dict]:
         """Run one compiled chunk; return episodes completed within it."""
-        self.state, self.rng, records = self._rollout(
-            self.wrapper.params, self.state, self.rng)
+        self.state, self.hidden, self.rng, records = self._rollout(
+            self.wrapper.params, self.state, self.hidden, self.rng)
         rec = map_structure(lambda v: None if v is None else np.asarray(v),
                             dict(records))
         players = list(range(self.env_mod.NUM_PLAYERS))
@@ -136,7 +165,8 @@ class DeviceGenerator:
     def _moment_turn_based(self, rec, k, i, players):
         player = int(rec['player'][k, i])
         moment = _blank(players)
-        moment['observation'][player] = rec['obs'][k, i]
+        moment['observation'][player] = map_structure(
+            lambda v: v[k, i], rec['obs'])
         moment['selected_prob'][player] = float(rec['prob'][k, i])
         moment['action_mask'][player] = rec['amask'][k, i]
         moment['action'][player] = int(rec['action'][k, i])
@@ -153,7 +183,8 @@ class DeviceGenerator:
             if not rec['acting'][k, i, p]:
                 continue
             turn_players.append(p)
-            moment['observation'][p] = rec['obs'][k, i, p]
+            moment['observation'][p] = map_structure(
+                lambda v: v[k, i, p], rec['obs'])
             moment['selected_prob'][p] = float(rec['prob'][k, i, p])
             moment['action_mask'][p] = rec['amask'][k, i, p]
             moment['action'][p] = int(rec['action'][k, i, p])

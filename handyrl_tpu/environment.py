@@ -30,6 +30,7 @@ ENVS = {
 JAX_ENVS = {
     'TicTacToe': 'handyrl_tpu.envs.jax_tictactoe',
     'HungryGeese': 'handyrl_tpu.envs.jax_hungry_geese',
+    'Geister': 'handyrl_tpu.envs.jax_geister',
 }
 
 

@@ -636,11 +636,16 @@ class Learner:
 
     def shutdown(self):
         """Stop the trainer loop and join its thread so no daemon thread is
-        left inside XLA at interpreter exit (which aborts the process)."""
+        left inside XLA at interpreter exit (which aborts the process). The
+        join must outlast one full update step — slow recurrent models can
+        take seconds per step on CPU, and an unjoined thread inside XLA
+        compute at teardown aborts with 'exception not rethrown'."""
         self.shutdown_flag = True
         self.trainer.shutdown()
         if self._trainer_thread is not None:
-            self._trainer_thread.join(timeout=10)
+            self._trainer_thread.join(timeout=300)
+            if self._trainer_thread.is_alive():
+                print('warning: trainer thread still running at shutdown')
 
     def run(self):
         self._trainer_thread = threading.Thread(target=self.trainer.run,
