@@ -127,6 +127,8 @@ class DeviceGenerator:
                               'amask': amask, 'value': out.get('value'),
                               'player': player, 'done': done,
                               'outcome': env_mod.outcome(nstate)}
+                if hasattr(env_mod, 'rewards'):
+                    record['reward'] = env_mod.rewards(nstate)   # (N, P)
                 nstate = env_mod.auto_reset(nstate, done)
                 if recurrent:
                     # fresh episodes start with zero recurrent state
@@ -172,9 +174,14 @@ class DeviceGenerator:
         moment['action'][player] = int(rec['action'][k, i])
         if rec.get('value') is not None:
             moment['value'][player] = rec['value'][k, i]
-        moment['reward'] = {p: None for p in players}
+        moment['reward'] = self._rewards(rec, k, i, players)
         moment['turn'] = [player]
         return moment
+
+    def _rewards(self, rec, k, i, players):
+        if rec.get('reward') is None:
+            return {p: None for p in players}
+        return {p: float(rec['reward'][k, i, p]) for p in players}
 
     def _moment_simultaneous(self, rec, k, i, players):
         moment = _blank(players)
@@ -190,7 +197,7 @@ class DeviceGenerator:
             moment['action'][p] = int(rec['action'][k, i, p])
             if rec.get('value') is not None:
                 moment['value'][p] = rec['value'][k, i, p]
-        moment['reward'] = {p: None for p in players}
+        moment['reward'] = self._rewards(rec, k, i, players)
         moment['turn'] = turn_players
         return moment
 

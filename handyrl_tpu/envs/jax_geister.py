@@ -112,6 +112,13 @@ def outcome(state: State) -> jnp.ndarray:
     return jnp.stack([first, -first], axis=1)
 
 
+def rewards(state: State) -> jnp.ndarray:
+    """(N, 2) per-ply rewards: -0.01 to both players every ply (the host
+    env's ply-cost shaping, envs/geister.py reward())."""
+    n = state.board.shape[0]
+    return jnp.full((n, NUM_PLAYERS), -0.01, jnp.float32)
+
+
 def legal_mask(state: State) -> jnp.ndarray:
     """(N, 214) float 1 = legal for the side to move."""
     n = state.board.shape[0]

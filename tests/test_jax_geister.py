@@ -81,6 +81,13 @@ def test_recurrent_device_generation():
     ep = episodes[0]
     moments = decompress_moments(ep['moment'])
     assert len(moments) == ep['steps']
+    # the host env pays -0.01/ply to both players; the device path must too,
+    # and the stored discounted returns must reflect it
+    for m in moments:
+        assert m['reward'][0] == pytest.approx(-0.01)
+        assert m['reward'][1] == pytest.approx(-0.01)
+    assert moments[-1]['return'][0] == pytest.approx(-0.01)
+    assert moments[0]['return'][0] < -0.01
     # replay recorded actions through the host env (setup plies included)
     host = HostGeister()
     host.reset()
