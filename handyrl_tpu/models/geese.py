@@ -11,7 +11,40 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from . import register
-from .blocks import TorusConv, to_nhwc
+from .blocks import ConvLSTMCell, TorusConv, to_nhwc
+
+
+@register('GeeseNetLSTM')
+class GeeseNetLSTM(nn.Module):
+    """Recurrent Hungry Geese net (the LSTM-era baseline configuration,
+    BASELINE.md row 4): torus-conv stem, ConvLSTM core carrying state across
+    plies, the same head readout as GeeseNet."""
+    filters: int = 32
+    stem_layers: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    def init_hidden(self, batch_shape=()):
+        shape = tuple(batch_shape) + (7, 11, self.filters)
+        zeros = jnp.zeros(shape, self.dtype)
+        return (zeros, zeros)
+
+    @nn.compact
+    def __call__(self, obs, hidden):
+        x = to_nhwc(obs)
+        h = nn.relu(TorusConv(self.filters, dtype=self.dtype)(x))
+        for _ in range(self.stem_layers):
+            h = nn.relu(h + TorusConv(self.filters, dtype=self.dtype)(h))
+        if hidden is None:
+            hidden = self.init_hidden(h.shape[:-3])
+        h, next_hidden = ConvLSTMCell(self.filters, dtype=self.dtype)(h, hidden)
+
+        head_mask = x[..., :1]
+        h_head = (h * head_mask).sum(axis=(-3, -2))
+        h_avg = h.mean(axis=(-3, -2))
+        policy = nn.Dense(4, use_bias=False, dtype=self.dtype)(h_head)
+        value = jnp.tanh(nn.Dense(1, use_bias=False, dtype=self.dtype)(
+            jnp.concatenate([h_head, h_avg], axis=-1)))
+        return {'policy': policy, 'value': value, 'hidden': next_hidden}
 
 
 @register('GeeseNet')

@@ -151,7 +151,14 @@ class Trainer:
         self.cfg = LossConfig.from_args(args)
 
         n_dev = len(jax.devices())
-        self.mesh = make_mesh() if n_dev > 1 else None
+        self.mesh = None
+        if n_dev > 1:
+            if args['batch_size'] % n_dev == 0:
+                self.mesh = make_mesh()
+            else:
+                print('batch_size %d not divisible by %d devices; '
+                      'training on a single device'
+                      % (args['batch_size'], n_dev))
         # the step donates its input state (params/opt buffers reused in
         # place); the actor-facing wrapper keeps its own copy of the params,
         # refreshed only at epoch boundaries
@@ -170,6 +177,7 @@ class Trainer:
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
         self.shutdown_flag = False
+        self.failed = False
 
         # throughput + profiling (the reference has no tracing at all —
         # SURVEY.md §5.1; here per-epoch step rate is tracked and a JAX
@@ -292,8 +300,23 @@ class Trainer:
             self.batcher.run()
             print('started training')
         while not self.shutdown_flag:
-            params = self.train()
-            state_blob = self.state_bytes() if self.state is not None else None
+            try:
+                if not self.failed:
+                    params = self.train()
+                    state_blob = (self.state_bytes()
+                                  if self.state is not None else None)
+                else:
+                    time.sleep(0.5)
+                    params, state_blob = None, None
+            except Exception:
+                # deliver (None, ...) instead of deadlocking the learner
+                # (it blocks on update_queue at every epoch boundary); the
+                # learner sees `failed` and shuts the run down — a dead
+                # optimizer must not keep minting checkpoint epochs
+                import traceback
+                traceback.print_exc()
+                self.failed = True
+                params, state_blob = None, None
             self.update_flag = False
             while not self.shutdown_flag:
                 try:
@@ -470,6 +493,10 @@ class Learner:
             print('generation stats = %.3f +- %.3f' % (mean, std))
 
         params, steps, state_blob = self.trainer.update()
+        if params is None and self.trainer.failed:
+            print('training failed (see traceback above); shutting down')
+            self.shutdown_flag = True
+            return
         if params is None:
             params = self.wrapper.params
         self.update_model(params, steps, state_blob)
