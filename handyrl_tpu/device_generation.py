@@ -65,9 +65,6 @@ class DeviceGenerator:
         apply_fn = wrapper.module.apply
         simultaneous = self.simultaneous
         recurrent = hasattr(wrapper.module, 'init_hidden')
-        if recurrent and simultaneous:
-            raise NotImplementedError(
-                'recurrent device generation is turn-based only for now')
         self.hidden = (wrapper.module.init_hidden(
             (n_envs, env_mod.NUM_PLAYERS)) if recurrent else None)
 
@@ -79,7 +76,17 @@ class DeviceGenerator:
                 if simultaneous:
                     N, P = obs.shape[:2]
                     flat = obs.reshape((N * P,) + obs.shape[2:])
-                    out = apply_fn(params, flat, None)
+                    if recurrent:
+                        # every player's hidden advances each ply (they all
+                        # observe); fold (N, P) into the batch dim
+                        h_in = jax.tree_util.tree_map(
+                            lambda h: h.reshape((N * P,) + h.shape[2:]), hidden)
+                        out = dict(apply_fn(params, flat, h_in))
+                        nh = out.pop('hidden')
+                        hidden = jax.tree_util.tree_map(
+                            lambda h: h.reshape((N, P) + h.shape[1:]), nh)
+                    else:
+                        out = apply_fn(params, flat, None)
                     legal = env_mod.legal_mask(state)          # (N, P, A)
                     amask = (1.0 - legal) * 1e32
                     logits = out['policy'].reshape(N, P, -1) - amask

@@ -151,6 +151,26 @@ def test_observation_matches_host_layout():
         np.testing.assert_array_equal(got, want)
 
 
+def test_device_generator_recurrent_simultaneous():
+    """GeeseNetLSTM through the device rollout: per-player ConvLSTM state
+    folded into the batch dim, zeroed on episode reset."""
+    wrapper = ModelWrapper(build('GeeseNetLSTM', filters=8, stem_layers=1))
+    wrapper.ensure_params(np.zeros((17, 7, 11), np.float32))
+    args = train_args(forward_steps=8, turn_based=False, observation=True)
+    args['gamma'] = 0.99
+    gen = DeviceGenerator(jhg, wrapper, args, n_envs=4, chunk_steps=16, seed=7)
+    episodes = []
+    for _ in range(8):
+        episodes += gen.step_chunk()
+        if len(episodes) >= 2:
+            break
+    assert len(episodes) >= 2
+    moments = decompress_moments(episodes[0]['moment'])
+    assert moments[0]['observation'][0].shape == (17, 7, 11)
+    batch = make_batch([select_episode(episodes, args) for _ in range(4)], args)
+    assert np.isfinite(np.asarray(batch['selected_prob'])).all()
+
+
 def test_device_generator_simultaneous_episodes():
     wrapper = ModelWrapper(build('GeeseNet', layers=2, filters=16))
     wrapper.ensure_params(np.zeros((17, 7, 11), np.float32))
