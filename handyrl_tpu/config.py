@@ -39,6 +39,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'batched_generation': True,   # in-process vectorized self-play actors
     'generation_envs': 64,        # env count per batched actor
     'device_generation': False,   # fully device-resident rollouts (envs with a pure-JAX twin)
+    'device_replay': False,       # HBM-resident replay ring; batches sampled on device
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads

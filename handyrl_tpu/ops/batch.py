@@ -88,6 +88,15 @@ def _replace_none(value, fallback):
 
 def _build_one(ep: dict, args: Dict[str, Any]) -> Dict[str, Any]:
     moments = decompress_moments(ep['moment'])[ep['start'] - ep['base']:ep['end'] - ep['base']]
+    return build_window(moments, ep, args)
+
+
+def build_window(moments: List[dict], ep: dict, args: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+    """Build one training window from already-decoded moments (``moments``
+    is the [start:end) slice; ``ep`` supplies outcome/start/end/train_start/
+    total). Lets callers that decode an episode once build many windows
+    without re-decompressing."""
     players = list(moments[0]['observation'].keys())
     if not args['turn_based_training']:   # solo training: one random seat
         players = [random.choice(players)]
@@ -165,10 +174,14 @@ def _build_one(ep: dict, args: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-def make_batch(episodes: Sequence[dict], args: Dict[str, Any]) -> Dict[str, Any]:
-    """Build a (B, T, P, ...) training batch from selected episode windows."""
-    rows = [_build_one(ep, args) for ep in episodes]
+def stack_windows(rows: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stack per-window dicts into one (B, T, P, ...) batch dict."""
     batch = {}
     for key in rows[0]:
         batch[key] = stack_structure([r[key] for r in rows])
     return batch
+
+
+def make_batch(episodes: Sequence[dict], args: Dict[str, Any]) -> Dict[str, Any]:
+    """Build a (B, T, P, ...) training batch from selected episode windows."""
+    return stack_windows([_build_one(ep, args) for ep in episodes])

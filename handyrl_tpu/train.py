@@ -173,6 +173,20 @@ class Trainer:
         self.data_cnt_ema = args['batch_size'] * args['forward_steps']
         self.steps = 0
         self.batcher = Batcher(args, self.episodes)
+
+        # optional HBM-resident replay: new episodes are windowed once on
+        # the host and pushed to a device ring; every SGD step then samples
+        # its batch on device (ops/replay.py)
+        self.replay = None
+        self.ingest_queue: Optional[queue.Queue] = None
+        if args.get('device_replay'):
+            from .ops.replay import DeviceReplay
+            windows_per_ep = max(1, 64 // args['forward_steps'])
+            self.replay = DeviceReplay(
+                capacity=min(args['maximum_episodes'], 4096) * windows_per_ep)
+            self.ingest_queue = queue.Queue(maxsize=1024)
+            self._pending_rows: List[Dict[str, Any]] = []
+            self._sample_key = jax.random.PRNGKey(args.get('seed', 0) + 1)
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
@@ -238,6 +252,16 @@ class Trainer:
         staged = None   # one-slot H2D prefetch: upload batch t+1 while t runs
 
         def stage_next():
+            if self.replay is not None:
+                self._ingest_new_episodes()
+                if self.replay.size == 0:
+                    time.sleep(0.1)
+                    return None
+                self._sample_key, key = jax.random.split(self._sample_key)
+                sampled = self.replay.sample(key, self.args['batch_size'])
+                if self.mesh is not None:
+                    sampled = shard_batch(self.mesh, sampled)
+                return sampled
             try:
                 nxt = self.batcher.batch(timeout=1.0)
             except queue.Empty:
@@ -284,6 +308,37 @@ class Trainer:
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
+    PUSH_CHUNK = 8   # fixed ring-push size => one XLA scatter compile
+
+    def _ingest_new_episodes(self):
+        """Window freshly generated episodes and push them into the device
+        ring. Each episode is decompressed ONCE; ~steps/forward_steps random
+        windows are sliced from the decoded moments; windows accumulate into
+        fixed-size chunks so the ring's scatter compiles exactly once."""
+        from .ops.batch import build_window, decompress_moments, stack_windows
+
+        ingested = 0
+        while ingested < 64:
+            try:
+                ep = self.ingest_queue.get_nowait()
+            except queue.Empty:
+                break
+            ingested += 1
+            moments = decompress_moments(ep['moment'])
+            fs, bi = self.args['forward_steps'], self.args['burn_in_steps']
+            for _ in range(max(1, ep['steps'] // fs)):
+                train_st = random.randrange(1 + max(0, ep['steps'] - fs))
+                st = max(0, train_st - bi)
+                ed = min(train_st + fs, ep['steps'])
+                meta = {'outcome': ep['outcome'], 'start': st, 'end': ed,
+                        'train_start': train_st, 'total': ep['steps']}
+                self._pending_rows.append(
+                    build_window(moments[st:ed], meta, self.args))
+        while len(self._pending_rows) >= self.PUSH_CHUNK:
+            chunk = self._pending_rows[:self.PUSH_CHUNK]
+            self._pending_rows = self._pending_rows[self.PUSH_CHUNK:]
+            self.replay.push(stack_windows(chunk))
+
     def _drain_metrics(self, pending: List[Dict[str, Any]]):
         for m in pending:
             for k, v in m.items():
@@ -297,7 +352,8 @@ class Trainer:
                and not self.shutdown_flag):
             time.sleep(1)
         if self.state is not None and not self.shutdown_flag:
-            self.batcher.run()
+            if self.replay is None:
+                self.batcher.run()
             print('started training')
         while not self.shutdown_flag:
             try:
@@ -433,12 +489,25 @@ class Learner:
             if self.num_returned_episodes % 100 == 0:
                 print(self.num_returned_episodes, end=' ', flush=True)
 
-        self.trainer.episodes.extend([e for e in episodes if e is not None])
+        live = [e for e in episodes if e is not None]
+        self.trainer.episodes.extend(live)
+        if self.trainer.ingest_queue is not None:
+            for e in live:
+                try:
+                    self.trainer.ingest_queue.put_nowait(e)
+                except queue.Full:
+                    break   # ring ingestion is best-effort under backlog
 
         mem_percent = psutil.virtual_memory().percent
         mem_ok = mem_percent <= 95
         maximum_episodes = (self.args['maximum_episodes'] if mem_ok else
                             int(len(self.trainer.episodes) * 95 / mem_percent))
+        if self.trainer.replay is not None:
+            # replay mode: training data lives in the HBM ring; the host
+            # deque only gates startup and feeds metrics — don't hold a
+            # second full copy of the buffer
+            maximum_episodes = min(maximum_episodes,
+                                   2 * self.args['minimum_episodes'])
         if not mem_ok and 'memory_over' not in self.flags:
             warnings.warn('memory usage %.1f%% with buffer size %d' %
                           (mem_percent, len(self.trainer.episodes)))
@@ -564,8 +633,12 @@ class Learner:
             self.feed_episodes(episodes)
 
             # keep the evaluation share near eval_rate: the vectorized
-            # evaluator advances all its matches one ply whenever behind
-            if self.num_results < self.eval_rate * self.num_episodes:
+            # evaluator advances all its matches one ply per call; chunked
+            # generators deliver episodes in bursts, so give eval several
+            # plies per loop iteration or it never finishes a match
+            for _ in range(16):
+                if self.num_results >= self.eval_rate * self.num_episodes:
+                    break
                 results = evaluator.step()
                 self.num_results += len(results)
                 self.feed_results(results)

@@ -1,0 +1,25 @@
+"""Learner end to end with the HBM-resident replay ring: device generation +
+on-device batch sampling (the fully device-centric pipeline)."""
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.train import Learner
+
+
+def test_learner_with_device_replay(tmp_path):
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 16, 'update_episodes': 40, 'minimum_episodes': 40,
+            'epochs': 2, 'generation_envs': 16, 'forward_steps': 8,
+            'num_batchers': 1, 'device_generation': True,
+            'device_replay': True,
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    learner.run()
+    assert learner.model_epoch == 2
+    assert learner.trainer.replay is not None
+    assert learner.trainer.replay.size > 0
+    assert learner.trainer.steps > 0
+    assert (tmp_path / 'models' / '2.ckpt').exists()
