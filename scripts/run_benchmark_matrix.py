@@ -22,6 +22,13 @@ ROWS = {
                        'update_episodes': 200, 'minimum_episodes': 400,
                        'generation_envs': 64},
     },
+    'ttt-device': {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'batch_size': 64, 'forward_steps': 8,
+                       'update_episodes': 200, 'minimum_episodes': 400,
+                       'generation_envs': 64,
+                       'device_generation': True, 'device_replay': True},
+    },
     'ttt-vtrace': {
         'env_args': {'env': 'TicTacToe'},
         'train_args': {'batch_size': 64, 'forward_steps': 8,
