@@ -8,37 +8,120 @@ the denominator comes from bench_baseline.json, produced by
 scripts/baseline_torch_learner.py — the same step in PyTorch on this host's
 CPU (the reference publishes no numbers of its own; see BASELINE.md).
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Robustness contract (round-2 hardening):
+  * exactly ONE JSON line is printed on stdout in every outcome — success,
+    backend unavailable, timeout, or signal — and the process exits 0;
+  * the backend is probed in a SUBPROCESS with a short deadline, so a wedged
+    TPU tunnel cannot hang this process (round 1 lost its whole driver
+    timeout to a blocking in-process ``jax.devices()`` retry loop);
+  * a global SIGALRM deadline (BENCH_DEADLINE_SEC, default 600) bounds the
+    whole run; SIGTERM/SIGINT emit the JSON line before exiting — children
+    are terminated politely (SIGTERM, never SIGKILL) so an axon client
+    holding the exclusive tunnel grant always gets to release it.
+
+Success line also carries diagnostics (extra keys are additive):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "device": ..., "flops_per_step": N, "mfu": N}
 """
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
 
-import numpy as np
+_EMITTED = False
+_CHILDREN = []
+
+METRIC = 'learner trajectories/sec (GeeseNet B=128 T=16, full update step)'
+UNIT = 'trajectories/sec'
+
+# bf16/fp32-with-MXU peak FLOP/s per chip by device_kind substring.
+# Public figures: v4 275T, v5e 197T, v5p 459T, v6e 918T (bf16).
+_PEAK_FLOPS = (
+    ('v6', 918e12),
+    ('v5p', 459e12),
+    ('v5 lite', 197e12),
+    ('v5e', 197e12),
+    ('v4', 275e12),
+    ('v3', 123e12),
+    ('v2', 45e12),
+)
 
 
-def _wait_for_backend(retries: int = 6, delay: float = 20.0):
-    """The axon TPU tunnel can be transiently unavailable (exclusive
-    single-client grant); retry init with backoff before giving up."""
-    import jax
-    for attempt in range(retries):
+def emit(value=0.0, vs_baseline=0.0, **extra):
+    """Print the one JSON result line (at most once) and flush."""
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    line = {'metric': METRIC, 'value': round(float(value), 2), 'unit': UNIT,
+            'vs_baseline': round(float(vs_baseline), 2)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _shutdown(signum, _frame):
+    for proc in _CHILDREN:
+        if proc.poll() is None:
+            proc.terminate()  # SIGTERM only: let axon clients drop the grant
+    emit(error='interrupted by signal %d before a number was measured' % signum)
+    sys.exit(0)
+
+
+def probe_backend(deadline: float) -> dict:
+    """Ask a subprocess what backend/device is reachable, under a hard cap.
+
+    Returns {'backend': ..., 'device_kind': ...} or {'error': ...}. The
+    subprocess is the fail-fast layer: if backend init blocks on a wedged
+    tunnel we SIGTERM it and report unavailable instead of hanging.
+    """
+    code = (
+        "import json, os, jax\n"
+        # honor an explicit operator platform choice: the axon site hook
+        # overrides JAX_PLATFORMS at import, so re-assert it via config
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "jax.config.update('jax_platforms', p) if p else None\n"
+        "d = jax.devices()[0]\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'device_kind': d.device_kind, 'n': jax.device_count()}))\n"
+    )
+    proc = subprocess.Popen([sys.executable, '-c', code],
+                            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                            text=True)
+    _CHILDREN.append(proc)
+    try:
+        out, _ = proc.communicate(timeout=deadline)
+        if proc.returncode == 0 and out.strip():
+            return json.loads(out.strip().splitlines()[-1])
+        return {'error': 'probe exited rc=%s' % proc.returncode}
+    except subprocess.TimeoutExpired:
+        proc.terminate()
         try:
-            return jax.devices()
-        except RuntimeError as e:
-            if attempt == retries - 1:
-                raise
-            print('# backend unavailable (%s); retry %d/%d in %.0fs'
-                  % (str(e).splitlines()[0][:80], attempt + 1, retries, delay),
-                  flush=True)
-            time.sleep(delay)
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # leave it to die with us; do not SIGKILL a grant holder
+        return {'error': 'backend init exceeded %.0fs fail-fast deadline'
+                         % deadline}
 
 
-def main():
+def peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def run_bench(probe: dict):
     import jax
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        jax.config.update('jax_platforms', plat)
     import jax.numpy as jnp
-    _wait_for_backend()
+    import numpy as np
+
     from handyrl_tpu.models import build
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.train_step import build_update_step, init_train_state
@@ -63,7 +146,19 @@ def main():
         batch = shard_batch(mesh, batch)
     lr = jnp.asarray(1e-5, jnp.float32)
 
-    # warmup/compile
+    # AOT-compile once; the same executable serves the cost analysis (XLA's
+    # own FLOP count) and the timed loop — no second trace/compile.
+    flops_per_step = 0.0
+    try:
+        step = step.lower(state, batch, lr).compile()
+        cost = step.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float((cost or {}).get('flops', 0.0))
+    except Exception:
+        pass   # fall back to the jitted callable; flops stay unreported
+
+    # warmup
     for _ in range(3):
         state, metrics = step(state, batch, lr)
     jax.block_until_ready(metrics['total'])
@@ -85,12 +180,34 @@ def main():
         if ref > 0:
             vs_baseline = traj_per_sec / ref
 
-    print(json.dumps({
-        'metric': 'learner trajectories/sec (GeeseNet B=128 T=16, full update step)',
-        'value': round(traj_per_sec, 2),
-        'unit': 'trajectories/sec',
-        'vs_baseline': round(vs_baseline, 2),
-    }))
+    # cost_analysis covers the whole (possibly sharded) program, so the
+    # denominator is the peak of every device it ran across
+    peak = peak_flops(probe.get('device_kind', '')) * max(1, len(devices))
+    mfu = (flops_per_step * steps / dt / peak) if peak else 0.0
+    emit(traj_per_sec, vs_baseline,
+         device=probe.get('device_kind', 'unknown'),
+         backend=probe.get('backend', 'unknown'),
+         step_ms=round(dt / steps * 1e3, 2),
+         flops_per_step=flops_per_step,
+         mfu=round(mfu, 4))
+
+
+def main():
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    deadline = float(os.environ.get('BENCH_DEADLINE_SEC', '600'))
+    signal.signal(signal.SIGALRM, _shutdown)
+    signal.alarm(int(deadline))
+
+    probe = probe_backend(min(120.0, deadline / 3))
+    if 'error' in probe:
+        emit(error='backend unavailable: ' + probe['error'])
+        return
+    try:
+        run_bench(probe)
+    except Exception as exc:  # noqa: BLE001 — the contract is: always emit
+        emit(error='%s: %s' % (type(exc).__name__, str(exc)[:200]),
+             device=probe.get('device_kind', 'unknown'))
 
 
 if __name__ == '__main__':
