@@ -1,8 +1,17 @@
-"""Agents: policies driving a single environment in matches.
+"""Match-time policies: the five agent kinds the evaluation stack speaks.
 
-Parity with the reference agent set (agent.py:13-113): RandomAgent,
-RuleBasedAgent, greedy/temperature Agent, EnsembleAgent (output averaging),
-SoftAgent (temperature 1).
+Round-2 redesign. The agent protocol (``reset`` / ``action`` / ``observe``,
+each taking ``(env, player, show)``) is the compatibility surface the match
+engines and the network-battle client dispatch on (reference agent.py:13-113
+defines the same five kinds); the implementations here are built around a
+single model-driven core:
+
+* legal-move handling is one helper producing ``-inf``-masked logits;
+* temperature is a parameter of :class:`Agent` (0 = argmax), so the "soft"
+  variant is just a preset;
+* :class:`EnsembleAgent` composes member ``Agent`` objects (each carrying
+  its own recurrent state) and averages their heads, rather than managing a
+  parallel list of models and hiddens by hand.
 """
 
 from __future__ import annotations
@@ -15,7 +24,27 @@ import numpy as np
 from .utils.tree import softmax
 
 
+def masked_logits(logits: np.ndarray, legal) -> np.ndarray:
+    """Logits with every illegal action driven to -inf."""
+    out = np.full_like(logits, -np.inf)
+    out[legal] = logits[legal]
+    return out
+
+
+def _show_outputs(env, probs, value):
+    """Human-readable policy/value dump; envs may override the format."""
+    if hasattr(env, 'print_outputs'):
+        env.print_outputs(probs, value)
+        return
+    if value is not None:
+        print('v = %f' % np.asarray(value).reshape(-1)[0])
+    if probs is not None:
+        print('p = %s' % (probs * 1000).astype(int))
+
+
 class RandomAgent:
+    """Uniform over legal actions; the universal baseline opponent."""
+
     def reset(self, env, show=False):
         pass
 
@@ -27,89 +56,91 @@ class RandomAgent:
 
 
 class RuleBasedAgent(RandomAgent):
-    """Defers to the env's ``rule_based_action`` when it has one."""
+    """Plays the env's scripted policy when one exists, else random."""
 
     def __init__(self, key: Optional[str] = None):
         self.key = key
 
     def action(self, env, player, show=False):
-        if hasattr(env, 'rule_based_action'):
-            return env.rule_based_action(player, key=self.key)
-        return random.choice(env.legal_actions(player))
-
-
-def print_outputs(env, prob, v):
-    if hasattr(env, 'print_outputs'):
-        env.print_outputs(prob, v)
-    else:
-        if v is not None:
-            print('v = %f' % v)
-        if prob is not None:
-            print('p = %s' % (prob * 1000).astype(int))
+        rule = getattr(env, 'rule_based_action', None)
+        if rule is None:
+            return super().action(env, player, show)
+        return rule(player, key=self.key)
 
 
 class Agent:
-    """Model-driven agent; temperature 0 = argmax over legal actions."""
+    """Model-driven agent.
 
-    def __init__(self, model, temperature: float = 0.0, observation: bool = True):
+    ``temperature`` 0 plays the argmax of the masked policy; otherwise
+    actions are sampled from softmax(logits / temperature). Recurrent
+    models carry their hidden state across the episode via ``reset``.
+    """
+
+    def __init__(self, model, temperature: float = 0.0,
+                 observation: bool = True):
         self.model = model
-        self.hidden = None
         self.temperature = temperature
         self.observation = observation
+        self.hidden = None
 
     def reset(self, env, show=False):
         self.hidden = self.model.init_hidden()
 
-    def plan(self, obs):
+    def _advance(self, obs) -> dict:
+        """One inference step; consumes and refreshes the hidden state."""
         outputs = self.model.inference(obs, self.hidden)
         self.hidden = outputs.pop('hidden', None)
         return outputs
 
-    def action(self, env, player, show=False):
-        outputs = self.plan(env.observation(player))
-        actions = env.legal_actions(player)
-        p = outputs['policy']
-        v = outputs.get('value', None)
-        mask = np.ones_like(p)
-        mask[actions] = 0
-        p = p - mask * 1e32
-
-        if show:
-            print_outputs(env, softmax(p), v)
-
+    def _pick(self, logits: np.ndarray) -> int:
         if self.temperature == 0:
-            return max(actions, key=lambda a: p[a])
-        probs = softmax(p / self.temperature)
-        return random.choices(np.arange(len(p)), weights=probs)[0]
+            return int(np.argmax(logits))
+        probs = softmax(logits / self.temperature)
+        return random.choices(range(len(logits)), weights=probs)[0]
+
+    def action(self, env, player, show=False):
+        outputs = self._advance(env.observation(player))
+        logits = masked_logits(outputs['policy'],
+                               env.legal_actions(player))
+        if show:
+            _show_outputs(env, softmax(logits), outputs.get('value'))
+        return self._pick(logits)
 
     def observe(self, env, player, show=False):
-        v = None
-        if self.observation:
-            outputs = self.plan(env.observation(player))
-            v = outputs.get('value', None)
-            if show:
-                print_outputs(env, None, v)
-        return v
+        if not self.observation:
+            return None
+        value = self._advance(env.observation(player)).get('value')
+        if show:
+            _show_outputs(env, None, value)
+        return value
 
 
 class EnsembleAgent(Agent):
-    """Averages the outputs of several models (each with its own hidden)."""
+    """Averages the output heads of several models.
+
+    Built as a committee of member Agents so each member keeps its own
+    hidden state; only the averaged heads leave the committee.
+    """
+
+    def __init__(self, models, temperature: float = 0.0,
+                 observation: bool = True):
+        super().__init__(None, temperature, observation)
+        self.members = [Agent(m) for m in models]
 
     def reset(self, env, show=False):
-        self.hidden = [model.init_hidden() for model in self.model]
+        for member in self.members:
+            member.reset(env, show)
 
-    def plan(self, obs):
-        outputs: dict = {}
-        for i, model in enumerate(self.model):
-            out = model.inference(obs, self.hidden[i])
-            for k, v in out.items():
-                if k == 'hidden':
-                    self.hidden[i] = v
-                else:
-                    outputs.setdefault(k, []).append(v)
-        return {k: np.mean(v, axis=0) for k, v in outputs.items()}
+    def _advance(self, obs) -> dict:
+        heads: dict = {}
+        for member in self.members:
+            for k, v in member._advance(obs).items():
+                heads.setdefault(k, []).append(v)
+        return {k: np.mean(vs, axis=0) for k, vs in heads.items()}
 
 
 class SoftAgent(Agent):
+    """Samples at temperature 1 — the exploration-faithful evaluator."""
+
     def __init__(self, model):
         super().__init__(model, temperature=1.0)

@@ -1,86 +1,225 @@
-"""Process/host communication substrate.
+"""Transport substrate: framed sockets, pipe workers, and an event-loop hub.
 
-Counterpart of the reference's connection layer (connection.py): 4-byte
-big-endian length-framed messages over TCP sockets plus mp.Pipe fan-out for
-same-host workers, thread-multiplexed into queues.
+Round-2 redesign of the communication layer. The wire format keeps the
+reference-compatible 4-byte big-endian length framing (reference
+connection.py:45-69 uses the same header), but everything else is built
+differently:
 
-Payloads are serialized with pickle — only ever our own episode/result dicts
-of numpy arrays between our own processes. Model parameters specifically are
-shipped as msgpack bytes + architecture name inside those dicts (see
-model.ModelWrapper.snapshot), never as pickled code objects, so a model
-snapshot cannot execute anything on load.
+* **Data-only codec.** Socket payloads are msgpack with an ndarray
+  extension type instead of pickle. A crafted frame from a network peer can
+  only ever decode to plain data — never to a code object — which closes the
+  remote-code-execution hole pickle leaves open on the public worker/eval
+  ports (9999/9998/9876). Same-host ``mp.Pipe`` endpoints keep mp's native
+  transport (kernel-mediated, same-user only).
+
+* **One event loop, not thread pairs.** ``Hub`` multiplexes any number of
+  heterogeneous endpoints (sockets and pipes) on a single ``selectors`` loop
+  with a self-wake pipe, per-endpoint outboxes, and command-queue attach /
+  detach — replacing the reference's two-threads-plus-0.3s-poll
+  QueueCommunicator design. Dead peers are detached on read/write errors;
+  peers are elastic by design.
+
+* **Demand-driven job dispatch.** ``JobPool`` primes each spawned worker
+  with one job and hands out the next the moment a result returns — a single
+  dispatcher thread with backpressure from the bounded result queue, instead
+  of separate sender/receiver threads with a free-connection queue.
 """
 
 from __future__ import annotations
 
-import io
-import multiprocessing as mp
-import multiprocessing.connection as mp_connection
-import pickle
+import os
 import queue
+import selectors
 import socket
 import struct
 import threading
-from typing import Callable, Iterator, List, Optional
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+_HEADER = struct.Struct('!i')
+_EXT_NDARRAY = 1
 
 
-def send_recv(conn, data):
-    conn.send(data)
-    return conn.recv()
+# ---------------------------------------------------------------------------
+# codec
 
 
-def force_cpu_backend():
-    """Pin this (sub)process's JAX to the CPU backend.
+def _encode_ext(obj):
+    if isinstance(obj, np.ndarray):
+        header = msgpack.packb([obj.dtype.str, list(obj.shape)],
+                               use_bin_type=True)
+        return msgpack.ExtType(
+            _EXT_NDARRAY, header + np.ascontiguousarray(obj).tobytes())
+    if isinstance(obj, np.generic):      # numpy scalar -> python scalar
+        return obj.item()
+    raise TypeError('refusing to serialize %r (data-only codec)' % type(obj))
 
-    Worker/eval processes must never claim the TPU: the learner holds the
-    single device, and the TPU plugin blocks a second client forever. Called
-    at the top of every child-process entry point. The explicit config
-    update is required because the axon site hook overrides JAX_PLATFORMS at
-    import time.
+
+def _decode_ext(code, data):
+    if code == _EXT_NDARRAY:
+        unpacker = msgpack.Unpacker(use_list=True, raw=False)
+        unpacker.feed(data)
+        dtype_str, shape = unpacker.unpack()
+        arr = np.frombuffer(data[unpacker.tell():], dtype=np.dtype(dtype_str))
+        return arr.reshape(shape).copy()
+    return msgpack.ExtType(code, data)
+
+
+def pack(msg) -> bytes:
+    """Serialize a message for the wire (msgpack + an ndarray extension).
+
+    Tuples normalize to lists across a socket hop — every protocol message
+    is a ``(kind, payload)`` pair and all receive sites sequence-unpack, so
+    the normalization is observable but harmless by design.
     """
-    import os
-    os.environ['JAX_PLATFORMS'] = 'cpu'
-    import jax
-    try:
-        jax.config.update('jax_platforms', 'cpu')
-    except Exception:
-        pass
+    return msgpack.packb(msg, default=_encode_ext, use_bin_type=True)
+
+
+def unpack(payload: bytes):
+    """Inverse of :func:`pack`. Decodes only data — never code objects."""
+    return msgpack.unpackb(payload, ext_hook=_decode_ext, raw=False,
+                           strict_map_key=False, use_list=True)
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+
+
+MAX_FRAME_BYTES = 256 * (1 << 20)   # largest legal payload (256 MiB)
+
+
+class FrameParser:
+    """Incremental splitter of a byte stream into length-framed payloads.
+
+    Frame lengths are attacker-controlled on the public ports, so they are
+    validated before any buffering commitment: a negative or oversized
+    header is a protocol violation and poisons the connection (the caller's
+    error handling detaches the peer) instead of letting a crafted header
+    pin gigabytes per connection or desync the stream."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf += data
+        frames = []
+        while True:
+            if len(self._buf) < _HEADER.size:
+                break
+            (n,) = _HEADER.unpack_from(self._buf)
+            if n < 0 or n > MAX_FRAME_BYTES:
+                raise ConnectionResetError(
+                    'protocol violation: frame length %d' % n)
+            if len(self._buf) < _HEADER.size + n:
+                break
+            frames.append(bytes(self._buf[_HEADER.size:_HEADER.size + n]))
+            del self._buf[:_HEADER.size + n]
+        return frames
 
 
 class FramedConnection:
-    """Length-framed messages over a stream socket."""
+    """Duplex message endpoint over a stream socket.
+
+    Blocking ``send``/``recv`` serve call-response clients; ``drain`` serves
+    the Hub's non-blocking read path via the incremental FrameParser.
+    """
 
     def __init__(self, sock: socket.socket):
-        self.conn: Optional[socket.socket] = sock
+        self.sock: Optional[socket.socket] = sock
+        self._parser = FrameParser()
+        self._ready: deque = deque()
 
-    def __del__(self):
-        self.close()
+    def fileno(self) -> int:
+        return self.sock.fileno()
 
     def close(self):
-        if self.conn is not None:
-            self.conn.close()
-            self.conn = None
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    __del__ = close
+
+    def send(self, msg):
+        payload = pack(msg)
+        if len(payload) > MAX_FRAME_BYTES:
+            raise ValueError('message of %d bytes exceeds the frame limit'
+                             % len(payload))
+        self.sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+    @staticmethod
+    def _decode(payload: bytes):
+        """A frame that passed the length check can still carry garbage; any
+        decode failure poisons the connection (callers detach/close) rather
+        than leaking arbitrary exceptions into multiplexer threads."""
+        try:
+            return unpack(payload)
+        except Exception as exc:
+            raise ConnectionResetError('undecodable frame (%s: %s)'
+                                       % (type(exc).__name__,
+                                          str(exc)[:80])) from exc
+
+    def recv(self):
+        if self._ready:
+            return self._decode(self._ready.popleft())
+        while not self._ready:
+            chunk = self.sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionResetError('peer closed')
+            self._ready.extend(self._parser.feed(chunk))
+        return self._decode(self._ready.popleft())
+
+    def drain(self) -> List[Any]:
+        """Non-blocking read of everything currently available."""
+        try:
+            chunk = self.sock.recv(1 << 16, socket.MSG_DONTWAIT)
+        except (BlockingIOError, InterruptedError):
+            return []
+        if not chunk:
+            raise ConnectionResetError('peer closed')
+        self._ready.extend(self._parser.feed(chunk))
+        out = [self._decode(p) for p in self._ready]
+        self._ready.clear()
+        return out
+
+
+class PipeEndpoint:
+    """Adapter giving an ``mp.Connection`` the same endpoint surface."""
+
+    def __init__(self, conn):
+        self.conn = conn
 
     def fileno(self) -> int:
         return self.conn.fileno()
 
-    def _recv_exact(self, size: int) -> bytes:
-        buf = io.BytesIO()
-        while size > 0:
-            chunk = self.conn.recv(size)
-            if len(chunk) == 0:
-                raise ConnectionResetError
-            size -= len(chunk)
-            buf.write(chunk)
-        return buf.getvalue()
-
-    def recv(self):
-        (size,) = struct.unpack('!i', self._recv_exact(4))
-        return pickle.loads(self._recv_exact(size))
+    def close(self):
+        self.conn.close()
 
     def send(self, msg):
-        payload = pickle.dumps(msg)
-        self.conn.sendall(struct.pack('!i', len(payload)) + payload)
+        self.conn.send(msg)
+
+    def recv(self):
+        return self.conn.recv()
+
+    def drain(self) -> List[Any]:
+        out = []
+        while self.conn.poll(0):
+            out.append(self.conn.recv())
+        return out
+
+
+def send_recv(conn, msg):
+    conn.send(msg)
+    return conn.recv()
+
+
+# ---------------------------------------------------------------------------
+# sockets
 
 
 def open_socket_connection(port: int) -> socket.socket:
@@ -92,141 +231,241 @@ def open_socket_connection(port: int) -> socket.socket:
 
 def connect_socket_connection(host: str, port: int) -> FramedConnection:
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    try:
-        sock.connect((host, int(port)))
-    except ConnectionRefusedError:
-        print('failed to connect %s %d' % (host, port))
+    sock.connect((host, int(port)))
     return FramedConnection(sock)
 
 
 def accept_socket_connections(port: int, timeout: Optional[float] = None,
                               maxsize: int = 1024
                               ) -> Iterator[Optional[FramedConnection]]:
+    """Yield one FramedConnection per accepted client; None on idle timeout."""
     sock = open_socket_connection(port)
     sock.listen(maxsize)
     sock.settimeout(timeout)
-    count = 0
-    while count < maxsize:
+    accepted = 0
+    while accepted < maxsize:
         try:
             conn, _ = sock.accept()
-            count += 1
-            yield FramedConnection(conn)
         except socket.timeout:
             yield None
+            continue
+        accepted += 1
+        yield FramedConnection(conn)
 
 
-def open_multiprocessing_connections(num_process: int, target: Callable,
-                                     args_func: Callable) -> List:
-    """Start ``num_process`` workers, each holding one end of an mp.Pipe;
-    returns the parent-side ends.
+# ---------------------------------------------------------------------------
+# event-loop hub
+
+
+class Hub:
+    """Message multiplexer: one selector read loop + one writer thread.
+
+    Incoming messages land in one inbox as ``(endpoint, message)``; outgoing
+    messages are posted to a shared outbox drained by the writer thread.
+    Reads never stall behind writes: a peer that stops consuming can block
+    the writer at most ``SEND_TIMEOUT`` seconds (sockets get a send
+    deadline on attach), after which it is detached — the read loop keeps
+    serving every other endpoint throughout. Endpoints may be attached /
+    detached from any thread at any time (workers are elastic); a failed
+    read or write detaches the endpoint.
+    """
+
+    SEND_TIMEOUT = 30.0
+
+    def __init__(self, endpoints: Optional[List] = None, inbox_max: int = 256):
+        self._inbox: queue.Queue = queue.Queue(maxsize=inbox_max)
+        self._outbox: queue.Queue = queue.Queue()
+        self._attached: set = set()
+        self._commands: deque = deque()
+        self._lock = threading.Lock()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(self._wake_r, selectors.EVENT_READ, None)
+        for ep in endpoints or []:
+            self.attach(ep)
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._write_loop, daemon=True).start()
+
+    # -- public api (any thread) --
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._attached)
+
+    # QueueCommunicator-compatible alias used by the learner's server loop
+    connection_count = count
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Any, Any]:
+        return self._inbox.get(timeout=timeout)
+
+    def send(self, endpoint, msg):
+        with self._lock:
+            if endpoint not in self._attached:
+                return
+        self._outbox.put((endpoint, msg))
+
+    def attach(self, endpoint):
+        sock = getattr(endpoint, 'sock', None)
+        if sock is not None:
+            sock.settimeout(self.SEND_TIMEOUT)   # bound writer-thread stalls
+        with self._lock:
+            self._attached.add(endpoint)
+            self._commands.append(('+', endpoint))
+        self._wake()
+
+    # API name kept for operator familiarity with the reference logs
+    add_connection = attach
+
+    def detach(self, endpoint):
+        print('disconnected')
+        with self._lock:
+            self._attached.discard(endpoint)
+            self._commands.append(('-', endpoint))
+        self._wake()
+
+    # -- loop internals --
+
+    def _wake(self):
+        try:
+            self._wake_w.send(b'.')
+        except OSError:
+            pass
+
+    def _apply_commands(self):
+        while True:
+            with self._lock:
+                if not self._commands:
+                    return
+                op, ep = self._commands.popleft()
+            try:
+                if op == '+':
+                    self._selector.register(ep, selectors.EVENT_READ, ep)
+                else:
+                    self._selector.unregister(ep)
+                    ep.close()
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _write_loop(self):
+        while True:
+            ep, msg = self._outbox.get()
+            with self._lock:
+                live = ep in self._attached
+            if not live:
+                continue
+            try:
+                ep.send(msg)
+            except (OSError, ValueError, TimeoutError, AttributeError):
+                self.detach(ep)   # AttributeError: closed while queued
+
+    def _read_loop(self):
+        while True:
+            events = self._selector.select(timeout=0.5)
+            for key, _mask in events:
+                if key.data is None:        # wake pipe
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                    continue
+                ep = key.data
+                try:
+                    msgs = ep.drain()
+                except (ConnectionResetError, EOFError, OSError):
+                    self.detach(ep)
+                    continue
+                for msg in msgs:
+                    self._inbox.put((ep, msg))
+            self._apply_commands()
+
+
+# ---------------------------------------------------------------------------
+# process fan-out
+
+
+def force_cpu_backend():
+    """Pin this (sub)process's JAX to the CPU backend.
+
+    Worker/eval processes must never claim the TPU: the learner holds the
+    single device, and the TPU plugin blocks a second client forever. Called
+    at the top of every child-process entry point. The explicit config
+    update is required because the axon site hook overrides JAX_PLATFORMS at
+    import time.
+    """
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
+def spawn_pipe_workers(count: int, target: Callable,
+                       make_args: Callable[[int, Any], tuple],
+                       daemon: bool = False) -> List[PipeEndpoint]:
+    """Spawn ``count`` processes, each holding one end of a duplex pipe.
 
     Uses the 'spawn' context: a forked child would inherit the parent's
     initialized JAX backend (possibly the exclusive TPU client); a spawned
     child starts clean and pins itself to CPU via force_cpu_backend().
+    Returns the parent-side pipe endpoints.
     """
+    import multiprocessing as mp
     ctx = mp.get_context('spawn')
-    parent_conns = []
-    for i in range(num_process):
-        conn0, conn1 = ctx.Pipe(duplex=True)
-        ctx.Process(target=target, args=args_func(i, conn1)).start()
-        conn1.close()
-        parent_conns.append(conn0)
-    return parent_conns
+    parents = []
+    for i in range(count):
+        ours, theirs = ctx.Pipe(duplex=True)
+        ctx.Process(target=target, args=make_args(i, theirs),
+                    daemon=daemon).start()
+        theirs.close()
+        parents.append(PipeEndpoint(ours))
+    return parents
 
 
-class MultiProcessJobExecutor:
-    """Round-robin job fan-out over worker processes.
+class JobPool:
+    """Fan jobs out to spawned worker processes, demand-driven.
 
-    A sender thread feeds the next item from ``send_generator`` to any free
-    worker; a receiver thread multiplexes results into a bounded queue.
+    ``job_source`` is an iterator of job payloads; ``worker_fn(conn, idx)``
+    is the child entry point (recv job -> send result, forever). One
+    dispatcher thread keeps every child busy: each result immediately buys
+    its sender the next job, then lands (optionally transformed) in
+    ``results`` — whose bound provides the backpressure.
     """
 
-    def __init__(self, func: Callable, send_generator, num_workers: int,
-                 postprocess: Optional[Callable] = None, out_maxsize: int = 8):
-        self.send_generator = send_generator
-        self.postprocess = postprocess
-        self.conns: List = []
-        self.waiting_conns: queue.Queue = queue.Queue()
-        self.output_queue: queue.Queue = queue.Queue(maxsize=out_maxsize)
+    def __init__(self, worker_fn: Callable, job_source, num_workers: int,
+                 transform: Optional[Callable] = None, results_max: int = 8):
+        self._jobs = job_source
+        self._transform = transform
+        self.results: queue.Queue = queue.Queue(maxsize=results_max)
+        self._endpoints = spawn_pipe_workers(
+            num_workers, worker_fn, lambda i, c: (c, i), daemon=True)
 
-        ctx = mp.get_context('spawn')   # never fork a TPU-holding parent
-        for i in range(num_workers):
-            conn0, conn1 = ctx.Pipe(duplex=True)
-            ctx.Process(target=func, args=(conn1, i), daemon=True).start()
-            conn1.close()
-            self.conns.append(conn0)
-            self.waiting_conns.put(conn0)
-
-    def recv(self):
-        return self.output_queue.get()
+    # Batcher compatibility: the learner reads .output_queue
+    @property
+    def output_queue(self) -> queue.Queue:
+        return self.results
 
     def start(self):
-        threading.Thread(target=self._sender, daemon=True).start()
-        threading.Thread(target=self._receiver, daemon=True).start()
+        threading.Thread(target=self._dispatch, daemon=True).start()
 
-    def _sender(self):
-        while True:
-            data = next(self.send_generator)
-            conn = self.waiting_conns.get()
-            conn.send(data)
+    def recv(self):
+        return self.results.get()
 
-    def _receiver(self):
-        while True:
-            for conn in mp_connection.wait(self.conns):
-                data = conn.recv()
-                self.waiting_conns.put(conn)
-                if self.postprocess is not None:
-                    data = self.postprocess(data)
-                self.output_queue.put(data)
-
-
-class QueueCommunicator:
-    """Bidirectional multiplexer over a dynamic set of connections.
-
-    Dead connections (reset/EOF/broken pipe) are dropped silently — workers
-    are elastic by design; the server keys only on connection_count().
-    """
-
-    def __init__(self, conns: Optional[List] = None, maxsize: int = 256):
-        self.input_queue: queue.Queue = queue.Queue(maxsize=maxsize)
-        self.output_queue: queue.Queue = queue.Queue(maxsize=maxsize)
-        self.conns: set = set()
-        for conn in conns or []:
-            self.add_connection(conn)
-        threading.Thread(target=self._send_thread, daemon=True).start()
-        threading.Thread(target=self._recv_thread, daemon=True).start()
-
-    def connection_count(self) -> int:
-        return len(self.conns)
-
-    def recv(self, timeout: Optional[float] = None):
-        return self.input_queue.get(timeout=timeout)
-
-    def send(self, conn, data):
-        self.output_queue.put((conn, data))
-
-    def add_connection(self, conn):
-        self.conns.add(conn)
-
-    def disconnect(self, conn):
-        print('disconnected')
-        self.conns.discard(conn)
-
-    def _send_thread(self):
-        while True:
-            conn, data = self.output_queue.get()
-            try:
-                conn.send(data)
-            except (TimeoutError, ConnectionResetError, BrokenPipeError):
-                self.disconnect(conn)
-
-    def _recv_thread(self):
-        while True:
-            conns = mp_connection.wait(self.conns, timeout=0.3)
-            for conn in conns:
+    def _dispatch(self):
+        import multiprocessing.connection as mpc
+        for ep in self._endpoints:
+            ep.send(next(self._jobs))
+        live = {ep.conn: ep for ep in self._endpoints}
+        while live:
+            for conn in mpc.wait(list(live)):
+                ep = live[conn]
                 try:
-                    data = conn.recv()
-                except (TimeoutError, ConnectionResetError, EOFError, OSError):
-                    self.disconnect(conn)
+                    result = ep.recv()
+                except (EOFError, OSError):
+                    del live[conn]
                     continue
-                self.input_queue.put((conn, data))
+                ep.send(next(self._jobs))     # refill before the maybe-block
+                if self._transform is not None:
+                    result = self._transform(result)
+                self.results.put(result)

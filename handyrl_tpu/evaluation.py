@@ -1,13 +1,22 @@
-"""Evaluation: online evaluator role, offline match harness, network battles.
+"""Evaluation: online evaluator role, offline tournaments, network battles.
 
-Parity with the reference evaluation stack (evaluation.py): shared-env
-matches (``exec_match``), delta-synced per-player env matches
-(``exec_network_match``), the multiprocess tournament runner with
-first/second-player balancing, and the TCP network battle mode on port 9876
-(server accepts remote/human agents speaking the diff_info protocol).
+Round-2 redesign of the evaluation stack. Feature parity with the reference
+(evaluation.py:83-285): shared-env matches, delta-synced remote matches over
+the diff_info protocol, a multiprocess tournament with first/second seat
+balancing for 2-player games, and the TCP battle mode on port 9876. The
+construction differs:
 
-Model files are our msgpack checkpoints (see train.py) — loading one cannot
-execute code, unlike unpickling a torch module.
+* one match engine (:func:`run_match`) drives every match; the difference
+  between a local agent and a remote client is a *seat* adapter
+  (:class:`_AgentSeat` / :class:`_WireSeat`), not a second engine;
+* the offline harness is a :class:`Tournament` object with explicit
+  schedule / launch / collect / report phases instead of one long function;
+* model files are our msgpack checkpoints (see train.py) — loading one
+  cannot execute code, unlike unpickling a torch module — and all network
+  traffic rides the data-only msgpack codec (connection.py).
+
+stdout formats (``total games``, ``---agent N---``, win-rate lines) are kept
+verbatim: the log format is the metrics interface the plot tooling parses.
 """
 
 from __future__ import annotations
@@ -19,10 +28,18 @@ from typing import Any, Dict, List, Optional
 
 from .agent import Agent, EnsembleAgent, RandomAgent, RuleBasedAgent, SoftAgent
 from .connection import (accept_socket_connections, connect_socket_connection,
-                         send_recv)
+                         force_cpu_backend, send_recv)
 from .environment import make_env, prepare_env
 
 network_match_port = 9876
+
+__all__ = [
+    'Agent', 'EnsembleAgent', 'RandomAgent', 'RuleBasedAgent', 'SoftAgent',
+    'NetworkAgent', 'NetworkAgentClient', 'Evaluator', 'ExportedModel',
+    'run_match', 'exec_match', 'exec_network_match', 'evaluate_mp',
+    'network_match_acception', 'wp_func', 'load_model', 'build_agent',
+    'eval_main', 'eval_server_main', 'eval_client_main',
+]
 
 
 def view(env, player=None):
@@ -37,9 +54,13 @@ def view_transition(env):
         env.view_transition()
 
 
+# ---------------------------------------------------------------------------
+# network battle protocol
+
+
 class NetworkAgentClient:
-    """Client side of a network battle: executes commands from the server
-    against a local env + agent."""
+    """Remote side of a battle: executes server commands against a local
+    env + agent. Commands: update / action / observe / outcome / quit."""
 
     def __init__(self, agent, env, conn):
         self.conn = conn
@@ -50,106 +71,154 @@ class NetworkAgentClient:
         while True:
             try:
                 command, args = self.conn.recv()
-            except ConnectionResetError:
+            except (ConnectionResetError, EOFError, OSError):
                 break
             if command == 'quit':
                 break
-            elif command == 'outcome':
-                print('outcome = %f' % args[0])
-            elif hasattr(self.agent, command):
-                if command in ('action', 'observe'):
-                    view(self.env)
-                ret = getattr(self.agent, command)(self.env, *args, show=True)
-                if command == 'action':
-                    player = args[0]
-                    ret = self.env.action2str(ret, player)
+            self.conn.send(self._execute(command, list(args)))
+
+    def _execute(self, command: str, args: list):
+        if command == 'outcome':
+            print('outcome = %f' % args[0])
+            return None
+        if command in ('action', 'observe'):
+            view(self.env)
+            reply = getattr(self.agent, command)(self.env, *args, show=True)
+            if command == 'action':
+                reply = self.env.action2str(reply, args[0])
+            return reply
+        # env-state command (update etc.) mirrored onto the local env
+        reply = getattr(self.env, command)(*args)
+        if command == 'update':
+            if args[1]:                        # reset flag: new game
+                self.agent.reset(self.env, show=True)
             else:
-                ret = getattr(self.env, command)(*args)
-                if command == 'update':
-                    reset = args[1]
-                    if reset:
-                        self.agent.reset(self.env, show=True)
-                    else:
-                        view_transition(self.env)
-            self.conn.send(ret)
+                view_transition(self.env)
+        return reply
 
 
 class NetworkAgent:
-    """Server-side stub driving a remote NetworkAgentClient."""
+    """Learner-side proxy for one remote NetworkAgentClient."""
 
     def __init__(self, conn):
         self.conn = conn
 
-    def update(self, data, reset):
-        return send_recv(self.conn, ('update', [data, reset]))
+    def _call(self, command: str, *args):
+        return send_recv(self.conn, (command, list(args)))
 
-    def outcome(self, outcome):
-        return send_recv(self.conn, ('outcome', [outcome]))
+    def update(self, data, reset):
+        return self._call('update', data, reset)
+
+    def outcome(self, value):
+        return self._call('outcome', value)
 
     def action(self, player):
-        return send_recv(self.conn, ('action', [player]))
+        return self._call('action', player)
 
     def observe(self, player):
-        return send_recv(self.conn, ('observe', [player]))
+        return self._call('observe', player)
+
+
+# ---------------------------------------------------------------------------
+# match engine
+
+
+class _AgentSeat:
+    """A player slot occupied by an in-process agent on the shared env."""
+
+    def __init__(self, agent):
+        self.agent = agent
+
+    def begin(self, env, player, show):
+        self.agent.reset(env, show=show)
+
+    def act(self, env, player, show):
+        return self.agent.action(env, player, show=show)
+
+    def watch(self, env, player, show):
+        self.agent.observe(env, player, show=show)
+
+    def sync(self, env, player):
+        pass
+
+    def finish(self, env, player, outcome):
+        pass
+
+
+class _WireSeat:
+    """A player slot occupied by a remote client that mirrors the env from
+    diff_info deltas and exchanges actions as strings."""
+
+    def __init__(self, proxy: NetworkAgent):
+        self.proxy = proxy
+
+    def begin(self, env, player, show):
+        self.proxy.update(env.diff_info(player), True)
+
+    def act(self, env, player, show):
+        return env.str2action(self.proxy.action(player), player)
+
+    def watch(self, env, player, show):
+        self.proxy.observe(player)
+
+    def sync(self, env, player):
+        self.proxy.update(env.diff_info(player), False)
+
+    def finish(self, env, player, outcome):
+        self.proxy.outcome(outcome)
+
+
+def run_match(env, seats: Dict[int, Any], critic=None, show=False,
+              game_args={}) -> Optional[dict]:
+    """Play one game to completion; None on env failure."""
+    if env.reset(game_args):
+        return None
+    for p, seat in seats.items():
+        seat.begin(env, p, show)
+    while not env.terminal():
+        if show:
+            view(env)
+            if critic is not None:
+                print('cv = ', critic.observe(env, None, show=False)[0])
+        acting, watching = env.turns(), env.observers()
+        moves = {}
+        for p, seat in seats.items():
+            if p in acting:
+                moves[p] = seat.act(env, p, show)
+            elif p in watching:
+                seat.watch(env, p, show)
+        if env.step(moves):
+            return None
+        if show:
+            view_transition(env)
+        for p, seat in seats.items():
+            seat.sync(env, p)
+    outcome = env.outcome()
+    if show:
+        print('final outcome = %s' % outcome)
+    for p, seat in seats.items():
+        seat.finish(env, p, outcome[p])
+    return {'result': outcome}
 
 
 def exec_match(env, agents: Dict[int, Any], critic=None, show=False,
                game_args={}) -> Optional[dict]:
-    """Match on one shared environment."""
-    if env.reset(game_args):
-        return None
-    for agent in agents.values():
-        agent.reset(env, show=show)
-    while not env.terminal():
-        if show:
-            view(env)
-        if show and critic is not None:
-            print('cv = ', critic.observe(env, None, show=False)[0])
-        turn_players = env.turns()
-        observers = env.observers()
-        actions = {}
-        for p, agent in agents.items():
-            if p in turn_players:
-                actions[p] = agent.action(env, p, show=show)
-            elif p in observers:
-                agent.observe(env, p, show=show)
-        if env.step(actions):
-            return None
-        if show:
-            view_transition(env)
-    outcome = env.outcome()
-    if show:
-        print('final outcome = %s' % outcome)
-    return {'result': outcome}
+    """Match between in-process agents on one shared environment."""
+    return run_match(env, {p: _AgentSeat(a) for p, a in agents.items()},
+                     critic, show, game_args)
 
 
 def exec_network_match(env, network_agents: Dict[int, NetworkAgent],
-                       critic=None, show=False, game_args={}) -> Optional[dict]:
-    """Match where each remote agent mirrors the env from diff_info deltas and
-    communicates actions as strings."""
-    if env.reset(game_args):
-        return None
-    for p, agent in network_agents.items():
-        agent.update(env.diff_info(p), True)
-    while not env.terminal():
-        if show:
-            view(env)
-        turn_players = env.turns()
-        observers = env.observers()
-        actions = {}
-        for p, agent in network_agents.items():
-            if p in turn_players:
-                actions[p] = env.str2action(agent.action(p), p)
-            elif p in observers:
-                agent.observe(p)
-        if env.step(actions):
-            return None
-        for p, agent in network_agents.items():
-            agent.update(env.diff_info(p), False)
-    outcome = env.outcome()
-    for p, agent in network_agents.items():
-        agent.outcome(outcome[p])
-    return {'result': outcome}
+                       critic=None, show=False, game_args={}
+                       ) -> Optional[dict]:
+    """Match against remote clients speaking the diff_info protocol."""
+    return run_match(env,
+                     {p: _WireSeat(a) for p, a in network_agents.items()},
+                     critic, show, game_args)
+
+
+# ---------------------------------------------------------------------------
+# online evaluation (during training)
 
 
 def build_agent(raw: str, env=None):
@@ -172,14 +241,12 @@ class Evaluator:
 
     def execute(self, models: Dict[int, Any], eval_args) -> Optional[dict]:
         opponents = self.args.get('eval', {}).get('opponent', [])
-        opponent = random.choice(opponents) if opponents else self.default_opponent
+        opponent = random.choice(opponents) if opponents \
+            else self.default_opponent
 
-        agents = {}
-        for p, model in models.items():
-            if model is None:
-                agents[p] = build_agent(opponent, self.env)
-            else:
-                agents[p] = Agent(model)
+        agents = {p: Agent(model) if model is not None
+                  else build_agent(opponent, self.env)
+                  for p, model in models.items()}
 
         results = exec_match(self.env, agents)
         if results is None:
@@ -188,111 +255,158 @@ class Evaluator:
         return {'args': eval_args, 'opponent': opponent, **results}
 
 
+# ---------------------------------------------------------------------------
+# offline tournament
+
+
 def wp_func(results: Dict[Optional[float], int]) -> float:
     games = sum(v for k, v in results.items() if k is not None)
     win = sum((k + 1) / 2 * v for k, v in results.items() if k is not None)
     return win / games if games else 0.0
 
 
-def eval_process_mp_child(agents, critic, env_args, index, in_queue, out_queue,
-                          seed, show=False):
-    from .connection import force_cpu_backend
+def _tournament_child(agents, critic, env_args, index, job_queue,
+                      result_queue, seed, show=False):
+    """One match-runner process: drain jobs until the None sentinel."""
     force_cpu_backend()
     random.seed(seed + index)
     env = make_env({**env_args, 'id': index})
+    remote_mode = isinstance(agents[0], NetworkAgent)
     while True:
-        args = in_queue.get()
-        if args is None:
+        job = job_queue.get()
+        if job is None:
             break
-        g, agent_ids, pat_idx, game_args = args
-        print('*** Game %d ***' % g)
-        agent_map = {env.players()[p]: agents[ai]
-                     for p, ai in enumerate(agent_ids)}
-        if isinstance(list(agent_map.values())[0], NetworkAgent):
-            results = exec_network_match(env, agent_map, critic, show=show,
-                                         game_args=game_args)
-        else:
-            results = exec_match(env, agent_map, critic, show=show,
-                                 game_args=game_args)
-        out_queue.put((pat_idx, agent_ids, results))
-    out_queue.put(None)
+        serial, seat_ids, label, game_args = job
+        print('*** Game %d ***' % serial)
+        lineup = {env.players()[i]: agents[ai]
+                  for i, ai in enumerate(seat_ids)}
+        engine = exec_network_match if remote_mode else exec_match
+        outcome = engine(env, lineup, critic, show=show, game_args=game_args)
+        result_queue.put((label, seat_ids, outcome))
+    result_queue.put(None)
+
+
+class Tournament:
+    """Offline round-robin harness over N processes.
+
+    ``schedule`` materializes every game up front (2-player games get
+    first/second seat balancing; larger games get shuffled seats);
+    ``launch`` starts the runner processes (or runs inline for 1 process);
+    ``collect`` tallies outcomes per agent per pattern; ``report`` prints
+    the reference-format summary the plot tooling parses.
+    """
+
+    def __init__(self, env, agents: List[Any], critic, env_args,
+                 args_patterns: Dict[str, dict], num_process: int,
+                 num_games: int, seed: int):
+        self.env = env
+        self.agents = agents
+        self.critic = critic
+        self.env_args = env_args
+        self.patterns = args_patterns
+        self.num_process = num_process
+        self.num_games = num_games
+        self.seed = seed
+        self.jobs: List[tuple] = []
+        self.by_pattern = [dict() for _ in agents]   # agent -> label -> tally
+        self.overall = [dict() for _ in agents]      # agent -> tally
+
+    def _seating(self, game_index: int) -> tuple:
+        """(label_suffix, seat assignment) for one game."""
+        n = len(self.agents)
+        if n == 2:
+            plays_first = game_index < (self.num_games + 1) // 2
+            return ('-F', [0, 1]) if plays_first else ('-S', [1, 0])
+        return ('', random.sample(range(n), n))
+
+    def schedule(self):
+        serial = 0
+        for label, game_args in self.patterns.items():
+            for i in range(self.num_games):
+                suffix, seat_ids = self._seating(i)
+                self.jobs.append((serial, seat_ids, label + suffix, game_args))
+                for tallies in self.by_pattern:
+                    tallies.setdefault(label + suffix, {})
+                serial += 1
+
+    def launch(self, per_process_agents: List[List[Any]], show_inline: bool):
+        job_queue: Any = mp.Queue()
+        self.results: Any = mp.Queue()
+        for job in self.jobs:
+            job_queue.put(job)
+        for _ in range(self.num_process):
+            job_queue.put(None)
+        for i in range(self.num_process):
+            child_args = (per_process_agents[i], self.critic, self.env_args,
+                          i, job_queue, self.results, self.seed)
+            if self.num_process > 1:
+                mp.Process(target=_tournament_child, args=child_args).start()
+                for agent in per_process_agents[i]:
+                    if isinstance(agent, NetworkAgent):
+                        agent.conn.close()   # child owns the duplicate now
+            else:
+                _tournament_child(*child_args, show=show_inline)
+
+    def collect(self):
+        pending = self.num_process
+        while pending > 0:
+            item = self.results.get()
+            if item is None:
+                pending -= 1
+                continue
+            label, seat_ids, match = item
+            outcome = (match or {}).get('result')
+            if outcome is None:
+                continue
+            for idx, player in enumerate(self.env.players()):
+                agent_id = seat_ids[idx]
+                score = outcome[player]
+                pat = self.by_pattern[agent_id][label]
+                pat[score] = pat.get(score, 0) + 1
+                self.overall[agent_id][score] = \
+                    self.overall[agent_id].get(score, 0) + 1
+
+    def report(self):
+        for a, per_pattern in enumerate(self.by_pattern):
+            print('---agent %d---' % a)
+            for label, tally in per_pattern.items():
+                print(label,
+                      {k: tally[k] for k in sorted(tally, reverse=True)},
+                      wp_func(tally))
+            print('total',
+                  {k: self.overall[a][k]
+                   for k in sorted(self.overall[a], reverse=True)},
+                  wp_func(self.overall[a]))
 
 
 def evaluate_mp(env, agents: List[Any], critic, env_args, args_patterns,
                 num_process: int, num_games: int, seed: int):
-    """Offline tournament: jobs over N processes; in 2-player games the
-    first/second seats are balanced across games."""
-    in_queue, out_queue = mp.Queue(), mp.Queue()
-    args_cnt = 0
-    total_results = [{} for _ in agents]
-    result_map = [{} for _ in agents]
+    """Run an offline tournament (compatibility wrapper over Tournament)."""
+    tournament = Tournament(env, agents, critic, env_args, args_patterns,
+                            num_process, num_games, seed)
     print('total games = %d' % (len(args_patterns) * num_games))
     time.sleep(0.1)
-    for pat_idx, args in args_patterns.items():
-        for i in range(num_games):
-            if len(agents) == 2:
-                first = 0 if i < (num_games + 1) // 2 else 1
-                tmp_pat_idx, agent_ids = ((pat_idx + '-F', [0, 1]) if first == 0
-                                          else (pat_idx + '-S', [1, 0]))
-            else:
-                tmp_pat_idx = pat_idx
-                agent_ids = random.sample(range(len(agents)), len(agents))
-            in_queue.put((args_cnt, agent_ids, tmp_pat_idx, args))
-            for p in range(len(agents)):
-                result_map[p][tmp_pat_idx] = {}
-            args_cnt += 1
+    tournament.schedule()
 
     network_mode = agents[0] is None
     if network_mode:
-        agents = network_match_acception(num_process, env_args, len(agents),
-                                         network_match_port)
+        per_process = network_match_acception(
+            num_process, env_args, len(agents), network_match_port)
     else:
-        agents = [agents] * num_process
+        per_process = [agents] * num_process
 
-    for i in range(num_process):
-        in_queue.put(None)
-        args = agents[i], critic, env_args, i, in_queue, out_queue, seed
-        if num_process > 1:
-            mp.Process(target=eval_process_mp_child, args=args).start()
-            if network_mode:
-                for agent in agents[i]:
-                    agent.conn.close()
-        else:
-            eval_process_mp_child(*args, show=True)
-
-    finished_cnt = 0
-    while finished_cnt < num_process:
-        ret = out_queue.get()
-        if ret is None:
-            finished_cnt += 1
-            continue
-        pat_idx, agent_ids, results = ret
-        outcome = results.get('result') if results else None
-        if outcome is not None:
-            for idx, p in enumerate(env.players()):
-                agent_id = agent_ids[idx]
-                oc = outcome[p]
-                result_map[agent_id][pat_idx][oc] = \
-                    result_map[agent_id][pat_idx].get(oc, 0) + 1
-                total_results[agent_id][oc] = total_results[agent_id].get(oc, 0) + 1
-
-    for p, r_map in enumerate(result_map):
-        print('---agent %d---' % p)
-        for pat_idx, results in r_map.items():
-            print(pat_idx, {k: results[k] for k in sorted(results, reverse=True)},
-                  wp_func(results))
-        print('total', {k: total_results[p][k]
-                        for k in sorted(total_results[p], reverse=True)},
-              wp_func(total_results[p]))
+    tournament.launch(per_process, show_inline=num_process == 1)
+    tournament.collect()
+    tournament.report()
 
 
 def network_match_acception(n: int, env_args, num_agents: int, port: int):
     """Accept exactly n*num_agents client connections, grouped per match;
     every accepted client immediately receives env_args (the reference only
     answered the first of each group and relied on surplus reconnects)."""
-    waiting, accepted = [], []
+    waiting, groups = [], []
     acceptor = accept_socket_connections(port)
-    while len(accepted) < n * num_agents:
+    while len(groups) < n:
         conn = next(acceptor)
         if conn is None:
             continue
@@ -300,10 +414,13 @@ def network_match_acception(n: int, env_args, num_agents: int, port: int):
         if len(waiting) == num_agents:
             for c in waiting:
                 c.send(env_args)
-            accepted += waiting
+            groups.append([NetworkAgent(c) for c in waiting])
             waiting = []
-    return [[NetworkAgent(accepted[i * num_agents + j])
-             for j in range(num_agents)] for i in range(n)]
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# model loading
 
 
 class ExportedModel:
@@ -322,7 +439,6 @@ class ExportedModel:
     def _open(self):
         if self._exported is not None:
             return
-        import jax
         from jax import export as jexport
         from jax import tree_util
         with open(self.model_path, 'rb') as f:
@@ -379,8 +495,11 @@ def _resolve_agent(model_path: str, env):
     return agent
 
 
+# ---------------------------------------------------------------------------
+# CLI entry points
+
+
 def eval_main(args, argv):
-    from .connection import force_cpu_backend
     force_cpu_backend()   # evaluation is a host-side workload
     env_args = args['env_args']
     prepare_env(env_args)
@@ -405,7 +524,6 @@ def eval_main(args, argv):
 
 
 def eval_server_main(args, argv):
-    from .connection import force_cpu_backend
     force_cpu_backend()
     print('network match server mode')
     env_args = args['env_args']
@@ -424,17 +542,13 @@ def eval_server_main(args, argv):
 
 
 def client_mp_child(env_args, model_path, conn):
-    from .connection import force_cpu_backend
     force_cpu_backend()
     env = make_env(env_args)
-    agent = build_agent(model_path, env)
-    if agent is None:
-        agent = Agent(load_model(model_path, env))
+    agent = _resolve_agent(model_path, env)
     NetworkAgentClient(agent, env, conn).run()
 
 
 def eval_client_main(args, argv):
-    from .connection import force_cpu_backend
     force_cpu_backend()
     print('network match client mode')
     while True:
@@ -442,7 +556,7 @@ def eval_client_main(args, argv):
             host = argv[1] if len(argv) >= 2 else 'localhost'
             conn = connect_socket_connection(host, network_match_port)
             env_args = conn.recv()
-        except ConnectionResetError:
+        except (ConnectionResetError, ConnectionRefusedError, OSError):
             break
         model_path = argv[0] if len(argv) >= 1 else 'models/latest.ckpt'
         mp.Process(target=client_mp_child,

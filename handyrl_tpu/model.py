@@ -96,11 +96,23 @@ class ModelWrapper:
         }
 
     @classmethod
-    def from_snapshot(cls, snap: Dict[str, Any], example_obs) -> 'ModelWrapper':
+    def from_snapshot(cls, snap: Dict[str, Any], example_obs,
+                      params_template=None) -> 'ModelWrapper':
+        """Rebuild a model from an architecture-name + params-bytes snapshot.
+
+        ``params_template`` (a params pytree of the same architecture) skips
+        the module.init trace — callers that materialize many snapshots of
+        one architecture (e.g. the worker model vault, every epoch) pay the
+        init exactly once."""
         module = model_zoo.build(snap['architecture'])
         wrapper = cls(module)
-        wrapper.ensure_params(example_obs)
-        wrapper.params = serialization.from_bytes(wrapper.params, snap['params'])
+        if params_template is None:
+            wrapper.ensure_params(example_obs)
+            wrapper.params = serialization.from_bytes(wrapper.params,
+                                                      snap['params'])
+        else:
+            wrapper.params = serialization.from_bytes(params_template,
+                                                      snap['params'])
         return wrapper
 
     def load_params_bytes(self, raw: bytes, example_obs) -> None:

@@ -70,7 +70,7 @@ class Batcher:
     Default: prefetch threads (bz2/numpy release the GIL for the heavy
     parts). With ``batcher_processes: True``, window selection stays in the
     learner process and make_batch fans out to spawned CPU processes via
-    MultiProcessJobExecutor — the reference's num_batchers subprocess layout
+    JobPool — the reference's num_batchers subprocess layout
     (train.py:270-318)."""
 
     def __init__(self, args: Dict[str, Any], episodes: deque):
@@ -102,8 +102,8 @@ class Batcher:
             return
         self._started = True
         if self.args.get('batcher_processes'):
-            from .connection import MultiProcessJobExecutor
-            self._executor = MultiProcessJobExecutor(
+            from .connection import JobPool
+            self._executor = JobPool(
                 _batcher_process, self._selector(),
                 self.args['num_batchers'])
             self._executor.start()
@@ -181,12 +181,20 @@ class Trainer:
         self.ingest_queue: Optional[queue.Queue] = None
         if args.get('device_replay'):
             from .ops.replay import DeviceReplay
-            windows_per_ep = max(1, 64 // args['forward_steps'])
+            # ring capacity budget per episode: how many training windows a
+            # typical episode contributes; override via config
+            # 'replay_windows_per_episode' (default assumes ~64-step episodes)
+            windows_per_ep = (args.get('replay_windows_per_episode')
+                              or max(1, 64 // args['forward_steps']))
             self.replay = DeviceReplay(
                 capacity=min(args['maximum_episodes'], 4096) * windows_per_ep)
             self.ingest_queue = queue.Queue(maxsize=1024)
             self._pending_rows: List[Dict[str, Any]] = []
             self._sample_key = jax.random.PRNGKey(args.get('seed', 0) + 1)
+            # observability: audited by metrics JSONL (replay_* fields)
+            self.replay_stats = {'dropped_episodes': 0,
+                                 'windows_ingested': 0,
+                                 'samples_drawn': 0}
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
@@ -259,6 +267,7 @@ class Trainer:
                     return None
                 self._sample_key, key = jax.random.split(self._sample_key)
                 sampled = self.replay.sample(key, self.args['batch_size'])
+                self.replay_stats['samples_drawn'] += self.args['batch_size']
                 if self.mesh is not None:
                     sampled = shard_batch(self.mesh, sampled)
                 return sampled
@@ -338,6 +347,7 @@ class Trainer:
             chunk = self._pending_rows[:self.PUSH_CHUNK]
             self._pending_rows = self._pending_rows[self.PUSH_CHUNK:]
             self.replay.push(stack_windows(chunk))
+            self.replay_stats['windows_ingested'] += self.PUSH_CHUNK
 
     def _drain_metrics(self, pending: List[Dict[str, Any]]):
         for m in pending:
@@ -492,11 +502,13 @@ class Learner:
         live = [e for e in episodes if e is not None]
         self.trainer.episodes.extend(live)
         if self.trainer.ingest_queue is not None:
+            # best-effort under backlog, but every drop is counted — the
+            # metrics JSONL exposes how much generation never reached the ring
             for e in live:
                 try:
                     self.trainer.ingest_queue.put_nowait(e)
                 except queue.Full:
-                    break   # ring ingestion is best-effort under backlog
+                    self.trainer.replay_stats['dropped_episodes'] += 1
 
         mem_percent = psutil.virtual_memory().percent
         mem_ok = mem_percent <= 95
@@ -587,6 +599,13 @@ class Learner:
         if ev:
             n, r, _ = ev
             rec['win_rate'] = (r / (n + 1e-6) + 1) / 2
+        if self.trainer.replay is not None:
+            stats = self.trainer.replay_stats
+            rec['replay_dropped_episodes'] = stats['dropped_episodes']
+            rec['replay_ring_occupancy'] = round(
+                self.trainer.replay.size / self.trainer.replay.capacity, 4)
+            rec['replay_sample_reuse'] = round(
+                stats['samples_drawn'] / max(1, stats['windows_ingested']), 3)
         with open(self._metrics_path, 'a') as f:
             f.write(json.dumps(rec) + '\n')
 
@@ -761,12 +780,35 @@ class Learner:
             self.shutdown()
 
 
+def _init_multihost(args):
+    """Activate jax.distributed when configured (train_args['distributed']
+    dict or JAX_COORDINATOR_ADDRESS-style env vars); no-op on single host.
+
+    Must run before any other JAX use so jax.devices() sees the global
+    device set; parallel/mesh.py then spans hosts transparently (gradient
+    all-reduce on ICI within a slice, DCN across slices)."""
+    from .parallel import multihost
+    dist = (args.get('train_args') or {}).get('distributed') or {}
+    active = multihost.initialize(
+        coordinator_address=dist.get('coordinator_address'),
+        num_processes=dist.get('num_processes'),
+        process_id=dist.get('process_id'))
+    if active:
+        import jax
+        print('multi-host: process %d of %d, %d global devices'
+              % (jax.process_index(), jax.process_count(),
+                 jax.device_count()))
+    return active
+
+
 def train_main(args):
+    _init_multihost(args)
     prepare_env(args['env_args'])
     learner = Learner(args=args)
     learner.run()
 
 
 def train_server_main(args):
+    _init_multihost(args)
     learner = Learner(args=args, remote=True)
     learner.run()

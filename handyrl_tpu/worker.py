@@ -1,304 +1,339 @@
-"""Worker processes, gather fan-in proxies, and cluster front-ends.
+"""Actor-side process tree: episode workers, relay proxies, cluster fronts.
 
-Topology parity with the reference (worker.py): learner -> gathers (one per
-~16 workers, amortizing RPCs via request prefetch, model caching, and result
-batching) -> workers running Generator/Evaluator episodes. Local mode forks
-processes over mp.Pipe; remote mode connects over TCP with an entry
-handshake on port 9999 (base_worker_id assignment + merged config) and data
-connections on port 9998.
+Round-2 redesign of the actor plumbing. The wire protocol is unchanged —
+the four RPCs (``args`` / ``episode`` / ``result`` / ``model``), the entry
+handshake on port 9999 (base_worker_id assignment + merged config), and the
+data connections on port 9998 all match the reference topology
+(reference worker.py:26-254) — but the machinery is built differently:
 
-Differences from the reference: the 'model' RPC answers with an
-architecture-name + msgpack-params snapshot (model.ModelWrapper.snapshot)
-instead of a pickled nn.Module (reference worker.py:46-47) — a worker can
-reconstruct the model without trusting the wire to carry code.
+* every multiplexing component composes a :class:`~.connection.Hub`
+  (single selector event loop) instead of subclassing a thread-pair
+  communicator;
+* workers cache model *snapshots per model id* in a small LRU vault and
+  materialize wrappers per id — two ids of the same architecture can never
+  alias one set of live params (a league/past-epoch opponent setup works);
+* the 'model' RPC ships an architecture-name + msgpack-params snapshot
+  (model.ModelWrapper.snapshot), never pickled code, and socket frames are
+  msgpack data — nothing on the public ports can execute on decode.
 """
 
 from __future__ import annotations
 
-import copy
-import functools
 import multiprocessing as mp
 import queue
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, defaultdict, deque
 from socket import gethostname
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
-from .connection import (QueueCommunicator, accept_socket_connections,
-                         connect_socket_connection,
-                         open_multiprocessing_connections, send_recv)
+from .connection import (Hub, accept_socket_connections,
+                         connect_socket_connection, force_cpu_backend,
+                         send_recv, spawn_pipe_workers)
 from .environment import make_env, prepare_env
 from .evaluation import Evaluator
 from .generation import Generator
 from .model import ModelWrapper, RandomModel
 
+ENTRY_PORT = 9999
+DATA_PORT = 9998
+
+
+class ModelVault:
+    """Small LRU of materialized models keyed by model id.
+
+    ``fetch(model_id)`` pulls a snapshot over the RPC connection on miss.
+    Each cached id owns its wrapper (sharing only the per-architecture jit
+    cache inside ModelWrapper), so distinct ids never share live params.
+    Id 0 denotes the untrained epoch-0 net and is served as a RandomModel —
+    a deliberate, documented divergence (see PARITY.md): its uniform play
+    matches the sampler's selected_prob, keeping training math identical.
+    """
+
+    def __init__(self, fetch, example_obs, capacity: int = 3):
+        self._fetch = fetch
+        self._example_obs = example_obs
+        self._capacity = capacity
+        self._slots: OrderedDict = OrderedDict()
+        self._templates: Dict[str, Any] = {}   # arch -> params pytree
+
+    def obtain(self, wanted: Dict[Any, Optional[int]]) -> Dict[Any, Any]:
+        """Return player -> model for every requested id (None/negative ->
+        no model: the server assigns those seats to built-in opponents)."""
+        out = {}
+        for player, mid in wanted.items():
+            if mid is None or mid < 0:
+                out[player] = None
+                continue
+            if mid not in self._slots:
+                self._admit(mid)
+            self._slots.move_to_end(mid)
+            out[player] = self._slots[mid]
+        return out
+
+    def _admit(self, mid: int):
+        snap = self._fetch(mid)
+        wrapper = ModelWrapper.from_snapshot(
+            snap, self._example_obs,
+            params_template=self._templates.get(snap['architecture']))
+        self._templates.setdefault(snap['architecture'], wrapper.params)
+        model = RandomModel(wrapper, self._example_obs) if mid == 0 else wrapper
+        while len(self._slots) >= self._capacity:
+            self._slots.popitem(last=False)
+        self._slots[mid] = model
+
 
 class Worker:
-    """Episode/evaluation executor: request loop over the 4-RPC protocol."""
+    """One actor process: loops task requests over the 4-RPC protocol and
+    plays out generation ('g') or evaluation ('e') assignments."""
 
     def __init__(self, args: Dict[str, Any], conn, wid: int):
         print('opened worker %d' % wid)
         self.worker_id = wid
-        self.args = args
         self.conn = conn
-        self.model_pool: Dict[int, Any] = {}
-        self._arch_wrappers: Dict[str, ModelWrapper] = {}
-
         self.env = make_env({**args['env'], 'id': wid})
-        self.generator = Generator(self.env, self.args)
-        self.evaluator = Evaluator(self.env, self.args)
-
         random.seed(args['seed'] + wid)
+
+        self.env.reset()
+        example_obs = self.env.observation(self.env.players()[0])
+        self.vault = ModelVault(
+            lambda mid: send_recv(conn, ('model', mid)), example_obs)
+
+        generator = Generator(self.env, args)
+        evaluator = Evaluator(self.env, args)
+        # role -> (episode producer, upload RPC name)
+        self.playbook = {'g': (generator.execute, 'episode'),
+                         'e': (evaluator.execute, 'result')}
 
     def __del__(self):
         print('closed worker %d' % self.worker_id)
 
-    def _example_obs(self):
-        self.env.reset()
-        return self.env.observation(self.env.players()[0])
-
-    def _gather_models(self, model_ids):
-        for model_id in model_ids:
-            if model_id is None or model_id < 0 or model_id in self.model_pool:
-                continue
-            snap = send_recv(self.conn, ('model', model_id))
-            # reuse one wrapper per architecture: loading new params into it
-            # keeps the compiled apply and the param template across epochs
-            arch = snap['architecture']
-            wrapper = self._arch_wrappers.get(arch)
-            if wrapper is None:
-                wrapper = ModelWrapper.from_snapshot(snap, self._example_obs())
-                self._arch_wrappers[arch] = wrapper
-            else:
-                wrapper.load_params_bytes(snap['params'], self._example_obs())
-            model = wrapper
-            if model_id == 0:
-                # epoch 0 means an untrained net: play uniformly at random
-                model = RandomModel(wrapper, self._example_obs())
-            # single-slot cache: evict the oldest entry
-            if len(self.model_pool) >= 1:
-                self.model_pool.pop(next(iter(self.model_pool)))
-            self.model_pool[model_id] = model
-
     def run(self):
         while True:
-            role_args = send_recv(self.conn, ('args', None))
-            if role_args is None:
+            task = send_recv(self.conn, ('args', None))
+            if task is None:
                 break
-            role = role_args['role']
-
-            models = {}
-            if 'model_id' in role_args:
-                self._gather_models(list(role_args['model_id'].values()))
-                for p, model_id in role_args['model_id'].items():
-                    models[p] = self.model_pool.get(model_id, None)
-
-            if role == 'g':
-                episode = self.generator.execute(models, role_args)
-                send_recv(self.conn, ('episode', episode))
-            elif role == 'e':
-                result = self.evaluator.execute(models, role_args)
-                send_recv(self.conn, ('result', result))
-
-
-def _worker_args(args, n_gathers, gather_id, base_wid, wid, conn):
-    return args, conn, base_wid + wid * n_gathers + gather_id
+            produce, upload_as = self.playbook[task['role']]
+            models = self.vault.obtain(dict(task.get('model_id', {})))
+            send_recv(self.conn, (upload_as, produce(models, task)))
 
 
 def open_worker(args, conn, wid):
-    from .connection import force_cpu_backend
     force_cpu_backend()
-    worker = Worker(args, conn, wid)
-    worker.run()
+    Worker(args, conn, wid).run()
 
 
-class Gather(QueueCommunicator):
-    """Fan-in proxy for ~16 workers: prefetches 'args' from the server in
-    bulk, caches 'model' responses by id, and flushes episodes/results in
-    batches (reference worker.py:92-161)."""
+def _shard(total: int, parts: int, index: int) -> int:
+    """Size of shard ``index`` when ``total`` items split across ``parts``."""
+    return total // parts + (1 if index < total % parts else 0)
 
-    def __init__(self, args: Dict[str, Any], conn, gather_id: int):
+
+class Gather:
+    """Fan-in relay between ~16 workers and the learner.
+
+    Amortizes server round-trips three ways: task assignments are prefetched
+    in blocks, model snapshots are served from a per-id cache, and episode /
+    result uploads are batched before shipping. State lives in three small
+    stores; routing is a dispatch over the RPC kind.
+    """
+
+    def __init__(self, args: Dict[str, Any], server_conn, gather_id: int):
         print('started gather %d' % gather_id)
-        super().__init__()
         self.gather_id = gather_id
-        self.server_conn = conn
-        self.args_queue: deque = deque()
-        self.data_map: Dict[str, dict] = {'model': {}}
-        self.result_send_map: Dict[str, list] = {}
-        self.result_send_cnt = 0
+        self.server = server_conn
 
-        n_pro = args['worker']['num_parallel']
-        n_ga = args['worker']['num_gathers']
-        num_workers_here = (n_pro // n_ga) + int(gather_id < n_pro % n_ga)
-        base_wid = args['worker'].get('base_worker_id', 0)
+        n_total = args['worker']['num_parallel']
+        n_relays = args['worker']['num_gathers']
+        n_here = _shard(n_total, n_relays, gather_id)
+        first_wid = args['worker'].get('base_worker_id', 0)
 
-        worker_conns = open_multiprocessing_connections(
-            num_workers_here, open_worker,
-            functools.partial(_worker_args, args, n_ga, gather_id, base_wid))
-        for wconn in worker_conns:
-            self.add_connection(wconn)
+        def worker_args(i, child_conn):
+            wid = first_wid + i * n_relays + gather_id
+            return (args, child_conn, wid)
 
-        self.buffer_length = 1 + len(worker_conns) // 4
+        self.hub = Hub(spawn_pipe_workers(n_here, open_worker, worker_args))
+
+        self.block = 1 + n_here // 4          # round-trip amortization factor
+        self.SNAP_SLOTS = 4                   # snapshots cached per relay
+        self._task_stock: deque = deque()
+        self._snap_cache: OrderedDict = OrderedDict()
+        self._upload_box: Dict[str, list] = defaultdict(list)
+        self._upload_count = 0
 
     def __del__(self):
         print('finished gather %d' % self.gather_id)
 
+    # -- per-RPC handling --
+
+    def _next_task(self):
+        if not self._task_stock:
+            self._task_stock.extend(
+                send_recv(self.server, ('args', [None] * self.block)))
+        return self._task_stock.popleft()
+
+    def _snapshot(self, mid):
+        """Per-id snapshot LRU: one epoch's params per entry, bounded — the
+        epoch counter increments for the life of the run, so an unbounded
+        map would leak a params-sized blob per update."""
+        if mid not in self._snap_cache:
+            while len(self._snap_cache) >= self.SNAP_SLOTS:
+                self._snap_cache.popitem(last=False)
+            self._snap_cache[mid] = send_recv(self.server, ('model', mid))
+        self._snap_cache.move_to_end(mid)
+        return self._snap_cache[mid]
+
+    def _stash_upload(self, kind: str, payload):
+        self._upload_box[kind].append(payload)
+        self._upload_count += 1
+        if self._upload_count >= self.block:
+            for kind, batch in self._upload_box.items():
+                send_recv(self.server, (kind, batch))
+            self._upload_box.clear()
+            self._upload_count = 0
+
     def run(self):
-        while self.connection_count() > 0:
+        while self.hub.count() > 0:
             try:
-                conn, (command, args) = self.recv(timeout=0.3)
+                ep, (kind, body) = self.hub.recv(timeout=0.3)
             except queue.Empty:
                 continue
-
-            if command == 'args':
-                if len(self.args_queue) == 0:
-                    self.server_conn.send((command, [None] * self.buffer_length))
-                    self.args_queue += self.server_conn.recv()
-                self.send(conn, self.args_queue.popleft())
-
-            elif command in self.data_map:
-                data_id = args
-                if data_id not in self.data_map[command]:
-                    self.server_conn.send((command, args))
-                    self.data_map[command][data_id] = self.server_conn.recv()
-                self.send(conn, self.data_map[command][data_id])
-
+            if kind == 'args':
+                self.hub.send(ep, self._next_task())
+            elif kind == 'model':
+                self.hub.send(ep, self._snapshot(body))
             else:
-                # ack immediately, ship to the server in bulk later
-                self.send(conn, None)
-                self.result_send_map.setdefault(command, []).append(args)
-                self.result_send_cnt += 1
-                if self.result_send_cnt >= self.buffer_length:
-                    for cmd, args_list in self.result_send_map.items():
-                        self.server_conn.send((cmd, args_list))
-                        self.server_conn.recv()
-                    self.result_send_map = {}
-                    self.result_send_cnt = 0
+                self.hub.send(ep, None)       # ack now, ship in bulk later
+                self._stash_upload(kind, body)
 
 
 def gather_loop(args, conn, gather_id):
-    from .connection import force_cpu_backend
     force_cpu_backend()
-    gather = Gather(args, conn, gather_id)
-    gather.run()
+    Gather(args, conn, gather_id).run()
 
 
 def default_num_gathers(num_parallel: int) -> int:
     return 1 + max(0, num_parallel - 1) // 16
 
 
-class WorkerCluster(QueueCommunicator):
-    """Local mode: fork gather processes connected by mp.Pipe."""
+class WorkerCluster:
+    """Local mode: gather processes over spawned pipes, one hub in the
+    learner. ``recv``/``send``/``connection_count`` delegate to the hub —
+    the learner's server loop is transport-agnostic."""
 
     def __init__(self, args: Dict[str, Any]):
-        super().__init__()
         self.args = args
+        self.hub = Hub()
+
+    def connection_count(self) -> int:
+        return self.hub.count()
+
+    def recv(self, timeout: Optional[float] = None):
+        return self.hub.recv(timeout=timeout)
+
+    def send(self, conn, data):
+        self.hub.send(conn, data)
 
     def run(self):
-        if 'num_gathers' not in self.args['worker']:
-            self.args['worker']['num_gathers'] = \
-                default_num_gathers(self.args['worker']['num_parallel'])
-        ctx = mp.get_context('spawn')   # never fork a TPU-holding learner
-        for i in range(self.args['worker']['num_gathers']):
-            conn0, conn1 = ctx.Pipe(duplex=True)
-            ctx.Process(target=gather_loop, args=(self.args, conn1, i)).start()
-            conn1.close()
-            self.add_connection(conn0)
+        wargs = self.args['worker']
+        wargs.setdefault('num_gathers',
+                         default_num_gathers(wargs['num_parallel']))
+        for ep in spawn_pipe_workers(
+                wargs['num_gathers'], gather_loop,
+                lambda i, c: (self.args, c, i)):
+            self.hub.attach(ep)
 
 
-class WorkerServer(QueueCommunicator):
-    """Remote mode, learner side: entry handshake on :9999 (assigns
-    base_worker_id, returns merged config), worker data conns on :9998.
-    Workers may join or leave at any time."""
+class WorkerServer(WorkerCluster):
+    """Remote mode, learner side. Two listener threads: the entry port
+    hands each arriving host its base_worker_id plus the merged config;
+    the data port feeds accepted sockets straight into the hub. Hosts may
+    join or leave at any time, mid-training."""
 
-    ENTRY_PORT = 9999
-    WORKER_PORT = 9998
+    ENTRY_PORT = ENTRY_PORT
+    WORKER_PORT = DATA_PORT
 
     def __init__(self, args: Dict[str, Any]):
-        super().__init__()
-        self.args = args
-        self.total_worker_count = 0
+        super().__init__(args)
+        self._next_base_wid = 0
+
+    def _entry_loop(self):
+        print('started entry server %d' % self.ENTRY_PORT)
+        for conn in accept_socket_connections(port=self.ENTRY_PORT):
+            host_args = conn.recv()
+            print('accepted connection from %s!' % host_args['address'])
+            host_args['base_worker_id'] = self._next_base_wid
+            self._next_base_wid += host_args['num_parallel']
+            merged = dict(self.args)
+            merged['worker'] = host_args
+            conn.send(merged)
+            conn.close()
+
+    def _data_loop(self):
+        print('started worker server %d' % self.WORKER_PORT)
+        for conn in accept_socket_connections(port=self.WORKER_PORT):
+            self.hub.attach(conn)
 
     def run(self):
-        def entry_server(port):
-            print('started entry server %d' % port)
-            for conn in accept_socket_connections(port=port):
-                worker_args = conn.recv()
-                print('accepted connection from %s!' % worker_args['address'])
-                worker_args['base_worker_id'] = self.total_worker_count
-                self.total_worker_count += worker_args['num_parallel']
-                args = copy.deepcopy(self.args)
-                args['worker'] = worker_args
-                conn.send(args)
-                conn.close()
-
-        def worker_server(port):
-            print('started worker server %d' % port)
-            for conn in accept_socket_connections(port=port):
-                self.add_connection(conn)
-
-        threading.Thread(target=entry_server, args=(self.ENTRY_PORT,),
-                         daemon=True).start()
-        threading.Thread(target=worker_server, args=(self.WORKER_PORT,),
-                         daemon=True).start()
+        for loop in (self._entry_loop, self._data_loop):
+            threading.Thread(target=loop, daemon=True).start()
 
 
 def entry(worker_args, retries: int = 30, delay: float = 2.0):
     """Entry handshake with retry: the learner may still be starting (jax
     import + bind) when a worker host comes up."""
-    last_err = None
+    last_err: Optional[Exception] = None
+    port = WorkerServer.ENTRY_PORT
     for _ in range(retries):
         try:
-            conn = connect_socket_connection(worker_args['server_address'],
-                                             WorkerServer.ENTRY_PORT)
-            conn.send(worker_args)
-            args = conn.recv()
-            conn.close()
-            return args
+            conn = connect_socket_connection(
+                worker_args['server_address'], port)
+            try:
+                conn.send(worker_args)
+                return conn.recv()
+            finally:
+                conn.close()
         except (OSError, ConnectionResetError) as e:
             last_err = e
             time.sleep(delay)
     raise ConnectionError('could not reach training server at %s:%d (%s)'
-                          % (worker_args['server_address'],
-                             WorkerServer.ENTRY_PORT, last_err))
+                          % (worker_args['server_address'], port, last_err))
 
 
 class RemoteWorkerCluster:
-    """Remote mode, worker-host side: entry handshake then one socket per
-    gather."""
+    """Remote mode, worker-host side: entry handshake, then one data socket
+    per gather, each driven by its own spawned process."""
 
     def __init__(self, args: Dict[str, Any]):
         args['address'] = gethostname()
-        if 'num_gathers' not in args:
-            args['num_gathers'] = default_num_gathers(args['num_parallel'])
+        args.setdefault('num_gathers',
+                        default_num_gathers(args['num_parallel']))
         self.args = args
 
     def run(self):
-        args = entry(self.args)
-        print(args)
-        prepare_env(args['env'])
+        merged = entry(self.args)
+        print(merged)
+        prepare_env(merged['env'])
 
-        processes = []
         ctx = mp.get_context('spawn')
+        children = []
         try:
             for i in range(self.args['num_gathers']):
-                conn = connect_socket_connection(self.args['server_address'],
-                                                 WorkerServer.WORKER_PORT)
-                p = ctx.Process(target=gather_loop, args=(args, conn, i))
-                p.start()
-                conn.close()
-                processes.append(p)
+                sock = connect_socket_connection(
+                    self.args['server_address'], WorkerServer.WORKER_PORT)
+                proc = ctx.Process(target=gather_loop,
+                                   args=(merged, sock, i))
+                proc.start()
+                sock.close()
+                children.append(proc)
             while True:
                 time.sleep(100)
         finally:
-            for p in processes:
-                p.terminate()
+            for proc in children:
+                proc.terminate()
 
 
 def worker_main(args, argv):
-    from .connection import force_cpu_backend
     force_cpu_backend()   # worker hosts are CPU actors by design
     worker_args = args['worker_args']
     if len(argv) >= 1:

@@ -81,7 +81,7 @@ def test_network_battle_over_sockets(monkeypatch):
             try:
                 conn = connect_socket_connection('localhost', port)
                 conn.fileno()
-                conn.conn.getpeername()
+                conn.sock.getpeername()
                 break
             except OSError:
                 time.sleep(0.1)
