@@ -17,8 +17,11 @@ surface:
   * observations are the same 17x7x11 planes (heads, tails, bodies,
     previous heads — all rotated so the observing player is channel 0 — and
     food), built from the last two board states (hungry_geese.py:202-231);
-  * ``rule_based_action`` is a greedy food-seeker that avoids immediate
-    death (the reference delegates to kaggle's GreedyAgent).
+  * ``rule_based_action`` is a behavioral port of kaggle's GreedyAgent —
+    the same opponent the reference delegates to — so win rates "vs
+    rulebase" are comparable to the reference's (see the decision rules in
+    the method docstring and the agreement test in
+    tests/test_greedy_agent.py).
 """
 
 from __future__ import annotations
@@ -35,6 +38,9 @@ N_CELLS = R * C
 ACTIONS = ['NORTH', 'SOUTH', 'WEST', 'EAST']
 DELTAS = [(-1, 0), (1, 0), (0, -1), (0, 1)]
 OPPOSITE = {0: 1, 1: 0, 2: 3, 3: 2}
+# kaggle's Action enum iterates NORTH, EAST, SOUTH, WEST — the GreedyAgent's
+# candidate scan (and thus its tie-breaking) follows that order
+GREEDY_ACTION_ORDER = [0, 3, 1, 2]
 HUNGER_RATE = 40
 MAX_STEPS = 200
 N_FOOD = 2
@@ -217,49 +223,53 @@ class Environment(BaseEnvironment):
 
     # -- rule-based opponent ----------------------------------------------
     def rule_based_action(self, player: int, key=None) -> int:
-        """Greedy: head toward the nearest food, never reverse, avoid cells
-        that are currently occupied or contested by an adjacent head."""
+        """Behavioral port of kaggle_environments' GreedyAgent, which the
+        reference delegates to (reference hungry_geese.py:189-197).
+
+        Decision rules, in the kaggle agent's own terms: a candidate move
+        may not land on a cell adjacent to any opponent head, on any
+        non-tail goose cell (a tail vacates this turn and IS steppable), on
+        the tail of an opponent whose head is adjacent to food (about to
+        eat and keep that tail), and may not reverse the player's last
+        action. Among candidates it picks the minimum
+        *non-wrapped* Manhattan distance to the nearest food (the kaggle
+        agent does not wrap its distance metric), ties broken in its
+        Action-enum iteration order NORTH, EAST, SOUTH, WEST. If no
+        candidate survives, it plays uniformly at random over all four
+        actions (even a fatal one)."""
         goose = self.geese[player]
         if not goose:
             return 0
         head = goose[0]
-        hx, hy = divmod(head, C)
 
-        occupied = set()
-        danger = set()
-        for p, g in enumerate(self.geese):
-            if not g:
-                continue
-            occupied.update(g[:-1] if len(g) > 1 else g)  # tail will move on
-            if p != player:
-                for a in range(4):
-                    danger.add(_move(g[0], a))
+        opponents = [g for p, g in enumerate(self.geese) if p != player and g]
+        head_adjacent = {_move(g[0], a) for g in opponents for a in range(4)}
+        # kaggle's bodies EXCLUDE tails (goose[0:-1] — a tail cell vacates
+        # this turn), then add back the tails of opponents about to eat
+        bodies = {cell for g in self.geese for cell in g[:-1]}
+        eating_tails = {g[-1] for g in opponents
+                        if any(_move(g[0], a) in self.food for a in range(4))}
+        last = self.last_actions.get(player)
+        banned = OPPOSITE[last] if last is not None else None
 
-        banned = None
-        if player in self.last_actions:
-            banned = OPPOSITE[self.last_actions[player]]
+        def food_steps(cell: int) -> int:
+            x, y = divmod(cell, C)
+            return min((abs(x - fx) + abs(y - fy)
+                        for f in self.food for fx, fy in [divmod(f, C)]),
+                       default=0)
 
-        def torus_dist(a, b):
-            ax, ay = divmod(a, C)
-            bx, by = divmod(b, C)
-            dx = min((ax - bx) % R, (bx - ax) % R)
-            dy = min((ay - by) % C, (by - ay) % C)
-            return dx + dy
-
-        candidates = []
-        for a in range(4):
-            if a == banned:
-                continue
+        best = None
+        for a in GREEDY_ACTION_ORDER:
             to = _move(head, a)
-            if to in occupied:
+            if (a == banned or to in head_adjacent or to in bodies
+                    or to in eating_tails):
                 continue
-            risk = 1 if to in danger else 0
-            dist = min((torus_dist(to, f) for f in self.food), default=0)
-            candidates.append((risk, dist, a))
-        if not candidates:
-            return banned == 0 and 1 or 0
-        candidates.sort()
-        return candidates[0][2]
+            d = food_steps(to)
+            if best is None or d < best[0]:
+                best = (d, a)
+        if best is None:
+            return self.rng.randrange(4)
+        return best[1]
 
     def net(self):
         from ...models.geese import GeeseNet

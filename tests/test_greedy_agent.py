@@ -1,0 +1,175 @@
+"""Action-agreement tests for the HungryGeese rule-based opponent.
+
+The reference's ``rule_based_action`` delegates to kaggle_environments'
+GreedyAgent (reference hungry_geese.py:189-197). Our env ports that agent's
+decision rules; this file checks the port two ways:
+
+* scripted boards exercising each documented rule (food seeking, reversal
+  ban, head-adjacency avoidance, body avoidance, eating-tail avoidance);
+* a fuzz sweep comparing every move of random games against an independent
+  transcription of the kaggle algorithm kept here as the oracle.
+"""
+
+import random
+
+from handyrl_tpu.envs.kaggle.hungry_geese import (ACTIONS, C, Environment, R,
+                                                  _move)
+
+# kaggle's Action enum order — candidate scan and tie-break order
+_K_ORDER = ['NORTH', 'EAST', 'SOUTH', 'WEST']
+_K_DELTA = {'NORTH': (-1, 0), 'EAST': (0, 1), 'SOUTH': (1, 0),
+            'WEST': (0, -1)}
+_K_OPPOSITE = {'NORTH': 'SOUTH', 'SOUTH': 'NORTH',
+               'EAST': 'WEST', 'WEST': 'EAST'}
+
+
+def kaggle_greedy_oracle(env, player):
+    """Transcription of GreedyAgent.__call__ from kaggle_environments.
+
+    Returns the action index in env's ACTIONS order, or None when the
+    kaggle agent would fall back to a uniformly random action.
+    """
+    geese, food = env.geese, env.food
+
+    def translate(pos, name):
+        dr, dc = _K_DELTA[name]
+        r, c = divmod(pos, C)
+        return ((r + dr) % R) * C + (c + dc) % C
+
+    def adjacent(pos):
+        return [translate(pos, name) for name in _K_ORDER]
+
+    def min_distance(pos):
+        r, c = divmod(pos, C)
+        return min(abs(r - fr) + abs(c - fc)
+                   for f in food for fr, fc in [divmod(f, C)])
+
+    opponents = [g for i, g in enumerate(geese) if i != player and len(g) > 0]
+    head_adjacent_positions = {adj for opp in opponents
+                               for head in [opp[0]] for adj in adjacent(head)}
+    bodies = {pos for g in geese for pos in g[0:-1]}   # tails are steppable
+    tails = {opp[-1] for opp in opponents for head in [opp[0]]
+             if any(adj in food for adj in adjacent(head))}
+
+    last = env.last_actions.get(player)
+    last_name = ACTIONS[last] if last is not None else None
+
+    position = geese[player][0]
+    candidates = {name: min_distance(translate(position, name))
+                  for name in _K_ORDER
+                  if translate(position, name) not in head_adjacent_positions
+                  and translate(position, name) not in bodies
+                  and translate(position, name) not in tails
+                  and (last_name is None
+                       or name != _K_OPPOSITE[last_name])}
+    if not candidates:
+        return None
+    return ACTIONS.index(min(candidates, key=candidates.get))
+
+
+def _board(geese, food, last_actions=None):
+    env = Environment({'id': 0})
+    env.geese = [list(g) for g in geese]
+    env.alive = [bool(g) for g in geese]
+    env.food = list(food)
+    env.last_actions = dict(last_actions or {})
+    env.prev_geese = [list(g) for g in geese]
+    return env
+
+
+def cell(r, c):
+    return r * C + c
+
+
+def test_moves_toward_food():
+    env = _board([[cell(3, 3)], [], [], []], [cell(3, 7), cell(0, 0)])
+    assert ACTIONS[env.rule_based_action(0)] == 'EAST'
+    env = _board([[cell(3, 3)], [], [], []], [cell(6, 3)])
+    # food 3 rows south (non-wrapped metric: SOUTH shortens, NORTH doesn't)
+    assert ACTIONS[env.rule_based_action(0)] == 'SOUTH'
+
+
+def test_never_reverses_even_for_food():
+    # moving EAST, food directly behind: reversal (WEST) is banned
+    env = _board([[cell(3, 3), cell(3, 2)], [], [], []], [cell(3, 1)],
+                 {0: ACTIONS.index('EAST')})
+    assert ACTIONS[env.rule_based_action(0)] != 'WEST'
+
+
+def test_avoids_opponent_head_adjacency():
+    # food to the EAST, but an opponent head sits beyond it: the food cell
+    # is head-adjacent, so the greedy agent detours
+    env = _board([[cell(3, 3)], [cell(3, 5)], [], []], [cell(3, 4)])
+    assert ACTIONS[env.rule_based_action(0)] != 'EAST'
+
+
+def test_avoids_bodies_including_tails():
+    # opponent body (incl. tail) due EAST; food beyond it
+    env = _board([[cell(3, 3)], [cell(2, 4), cell(3, 4), cell(4, 4)], [], []],
+                 [cell(3, 6)])
+    assert ACTIONS[env.rule_based_action(0)] != 'EAST'
+
+
+def test_avoids_tail_of_opponent_about_to_eat():
+    # opponent head adjacent to food keeps its tail this turn: the tail
+    # cell is excluded even though tails are otherwise vacated
+    opp = [cell(0, 6), cell(0, 5), cell(0, 4), cell(1, 4), cell(2, 4),
+           cell(3, 4)]
+    env = _board([[cell(3, 3)], opp, [], []], [cell(0, 7)])
+    a = env.rule_based_action(0)
+    assert _move(cell(3, 3), a) != opp[-1]
+
+
+def test_steps_onto_vacating_opponent_tail():
+    # opponent head nowhere near food: its tail vacates this turn and IS a
+    # legal (and here optimal) destination — kaggle's bodies exclude tails
+    opp = [cell(1, 5), cell(2, 5), cell(3, 5), cell(3, 4)]
+    env = _board([[cell(3, 3)], opp, [], []], [cell(4, 4)])
+    assert ACTIONS[env.rule_based_action(0)] == 'EAST'
+
+
+def test_steps_onto_own_vacating_tail():
+    # own tail is never in the blocked set (and own goose is not an
+    # opponent, so no eating-tail exclusion applies)
+    own = [cell(3, 3), cell(2, 3), cell(2, 4), cell(3, 4)]
+    env = _board([own, [], [], []], [cell(3, 5)],
+                 {0: ACTIONS.index('SOUTH')})
+    assert ACTIONS[env.rule_based_action(0)] == 'EAST'
+
+
+def test_tie_break_follows_kaggle_enum_order():
+    # food equidistant NORTH and SOUTH: kaggle scans NORTH first
+    env = _board([[cell(3, 3)], [], [], []], [cell(1, 3), cell(5, 3)])
+    assert ACTIONS[env.rule_based_action(0)] == 'NORTH'
+
+
+def test_fuzz_agreement_with_kaggle_transcription():
+    rng = random.Random(7)
+    checked = 0
+    for game in range(25):
+        env = Environment({'id': game})
+        env.reset()
+        while not env.terminal():
+            for p in env.turns():
+                expected = kaggle_greedy_oracle(env, p)
+                got = env.rule_based_action(p)
+                if expected is None:      # kaggle falls back to random
+                    assert 0 <= got < 4
+                else:
+                    assert got == expected, (
+                        'disagreement at step %d player %d: ours %s, '
+                        'kaggle %s' % (env.step_count, p, ACTIONS[got],
+                                       ACTIONS[expected]))
+                    checked += 1
+            env.step({p: rng.randrange(4) for p in env.turns()})
+    assert checked > 300   # the sweep actually exercised the agreement
+
+
+def test_rulebase_games_complete():
+    env = Environment({'id': 1})
+    for _ in range(5):
+        env.reset()
+        while not env.terminal():
+            env.step({p: env.rule_based_action(p) for p in env.turns()})
+        outcome = env.outcome()
+        assert abs(sum(outcome.values())) < 1e-9
