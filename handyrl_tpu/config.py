@@ -40,8 +40,11 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'generation_envs': 64,        # env count per batched actor
     'device_generation': False,   # fully device-resident rollouts (envs with a pure-JAX twin)
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
+    'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
+    'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id
+
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
     'compute_dtype': '',          # '' = float32; 'bfloat16' for MXU-friendly activations
     'profile_dir': '',            # when set, capture a jax profiler trace early in training

@@ -1,11 +1,16 @@
 """Learner end to end with the HBM-resident replay ring: device generation +
-on-device batch sampling (the fully device-centric pipeline)."""
+on-device batch sampling (the fully device-centric pipeline), including the
+replay observability contract: drop counters, ring occupancy, and
+sample-reuse ratio must appear in the metrics JSONL."""
+
+import json
 
 from handyrl_tpu.config import apply_defaults
 from handyrl_tpu.train import Learner
 
 
 def test_learner_with_device_replay(tmp_path):
+    metrics_path = tmp_path / 'metrics.jsonl'
     raw = {
         'env_args': {'env': 'TicTacToe'},
         'train_args': {
@@ -14,6 +19,7 @@ def test_learner_with_device_replay(tmp_path):
             'num_batchers': 1, 'device_generation': True,
             'device_replay': True,
             'model_dir': str(tmp_path / 'models'),
+            'metrics_jsonl': str(metrics_path),
         },
     }
     learner = Learner(args=apply_defaults(raw))
@@ -23,3 +29,17 @@ def test_learner_with_device_replay(tmp_path):
     assert learner.trainer.replay.size > 0
     assert learner.trainer.steps > 0
     assert (tmp_path / 'models' / '2.ckpt').exists()
+
+    # replay observability: every epoch record carries the audit fields
+    records = [json.loads(line) for line in
+               metrics_path.read_text().splitlines()]
+    assert records, 'metrics JSONL should have one record per epoch'
+    for rec in records:
+        assert rec['replay_dropped_episodes'] >= 0
+        assert 0.0 <= rec['replay_ring_occupancy'] <= 1.0
+        assert rec['replay_sample_reuse'] >= 0.0
+    last = records[-1]
+    stats = learner.trainer.replay_stats
+    assert stats['windows_ingested'] > 0
+    assert stats['samples_drawn'] > 0
+    assert last['replay_ring_occupancy'] > 0.0
