@@ -35,12 +35,20 @@ def initialize(coordinator_address: Optional[str] = None,
     """
     import jax
 
-    explicit = coordinator_address is not None
-    env_driven = any(os.environ.get(k) for k in
-                     ('JAX_COORDINATOR_ADDRESS', 'COORDINATOR_ADDRESS',
-                      'MEGASCALE_COORDINATOR_ADDRESS'))
-    if not explicit and not env_driven:
-        return False
+    if coordinator_address is None:
+        # NB: MEGASCALE_COORDINATOR_ADDRESS is deliberately NOT consulted —
+        # it names libtpu's multislice DCN transport endpoint, not the
+        # jax.distributed coordinator service
+        coordinator_address = next(
+            (os.environ[k] for k in
+             ('JAX_COORDINATOR_ADDRESS', 'COORDINATOR_ADDRESS')
+             if os.environ.get(k)), None)
+        if coordinator_address is None:
+            return False
+    if num_processes is None and os.environ.get('JAX_NUM_PROCESSES'):
+        num_processes = int(os.environ['JAX_NUM_PROCESSES'])
+    if process_id is None and os.environ.get('JAX_PROCESS_ID'):
+        process_id = int(os.environ['JAX_PROCESS_ID'])
 
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -1,0 +1,84 @@
+"""Multi-host wiring test: two real processes join one jax.distributed job
+on the CPU backend, see the global device set, and run a cross-process
+collective. This validates the path train_main activates via
+``_init_multihost`` (train.py) / ``multihost.initialize`` before any JAX
+use — the learner-side counterpart of the reference's multi-node story
+(which only ever distributes CPU actors, reference worker.py:185-254)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+sys.path.insert(0, %(repo)r)
+from handyrl_tpu.parallel import multihost
+
+ok = multihost.initialize()          # resolved from JAX_COORDINATOR_ADDRESS
+assert ok, 'env-driven initialize() should activate'
+assert multihost.is_coordinator() == (jax.process_index() == 0)
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+# one real cross-process collective: everyone receives process 0's value
+val = multihost_utils.broadcast_one_to_all(
+    jnp.asarray(100.0 + jax.process_index()))
+print('OK', jax.process_index(), jax.process_count(), jax.device_count(),
+      float(val), flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(180)
+def test_two_process_jax_distributed_cpu(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / 'child.py'
+    script.write_text(_CHILD % {'repo': repo})
+    port = _free_port()
+
+    children = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   JAX_PLATFORMS='cpu',
+                   JAX_COORDINATOR_ADDRESS='localhost:%d' % port,
+                   JAX_NUM_PROCESSES='2',
+                   JAX_PROCESS_ID=str(pid))
+        env.pop('XLA_FLAGS', None)   # 1 device per process, no virtual mesh
+        children.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    outputs = []
+    for proc in children:
+        out, _ = proc.communicate(timeout=150)
+        outputs.append(out)
+        assert proc.returncode == 0, out
+
+    for pid, out in enumerate(outputs):
+        line = next(l for l in out.splitlines() if l.startswith('OK'))
+        _, idx, count, devices, val = line.split()
+        assert int(idx) == pid
+        assert int(count) == 2
+        assert int(devices) == 2          # global view: one CPU device each
+        assert float(val) == 100.0        # coordinator's value won
+
+
+def test_initialize_noop_without_configuration(monkeypatch):
+    for var in ('JAX_COORDINATOR_ADDRESS', 'COORDINATOR_ADDRESS',
+                'MEGASCALE_COORDINATOR_ADDRESS'):
+        monkeypatch.delenv(var, raising=False)
+    from handyrl_tpu.parallel import multihost
+    assert multihost.initialize() is False
