@@ -1,19 +1,58 @@
-"""Export a trained model to a portable serialized-StableHLO artifact.
+"""Export a trained model to a portable artifact.
 
-Counterpart of the reference's ONNX export (scripts/make_onnx_model.py):
-onnxruntime is not part of this stack, so the export format is
-``jax.export`` StableHLO with params baked in — loadable by any JAX install
-with no handyrl_tpu code (see handyrl_tpu.evaluation.ExportedModel, the
-OnnxModel counterpart). Hidden-state inputs/outputs are preserved for
-recurrent nets.
+Counterpart of the reference's ONNX export (scripts/make_onnx_model.py).
+Two formats:
 
-Usage: python scripts/export_model.py ENV CKPT_PATH OUT_PATH [BATCH]
+* default: ``jax.export`` StableHLO with params baked in — loadable by any
+  JAX install with no handyrl_tpu code (see
+  handyrl_tpu.evaluation.ExportedModel, the OnnxModel counterpart).
+  Hidden-state inputs/outputs are preserved for recurrent nets.
+* ``--torch``: a TorchScript ``.pt`` (see scripts/torch_export.py) that
+  ``torch.jit.load`` runs anywhere torch does, with zero handyrl_tpu /
+  jax / flax dependency — the portability contract of the reference's
+  .onnx files (this image has no ONNX writer: no onnx/onnxscript/tf).
+  Feed-forward architectures only; the transplant is numerically validated
+  against the flax forward before the file is written.
+
+Usage: python scripts/export_model.py [--torch] ENV CKPT_PATH OUT_PATH
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+
+def main_torch(argv):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.evaluation import load_model
+    from torch_export import export_torchscript, validate_against_flax
+
+    env_name = argv[0] if len(argv) > 0 else 'TicTacToe'
+    ckpt = argv[1] if len(argv) > 1 else 'models/latest.ckpt'
+    out_path = argv[2] if len(argv) > 2 else 'models/latest.pt'
+
+    env = make_env({'env': env_name})
+    env.reset()
+    example_obs = env.observation(env.players()[0])
+    wrapper = load_model(ckpt, env)
+    arch = type(wrapper.module).__name__
+
+    mirror = export_torchscript(arch, wrapper.params, example_obs, out_path)
+    dev = validate_against_flax(mirror, wrapper, example_obs)
+    print('wrote', out_path, os.path.getsize(out_path),
+          'bytes (max deviation vs flax: %.2e)' % dev)
+
+    # self-test: a fresh torch.jit.load needs none of our code
+    import numpy as np
+    import torch
+    reloaded = torch.jit.load(out_path)
+    policy, value = reloaded(torch.from_numpy(
+        np.asarray(example_obs, np.float32)[None]))
+    print('reload check ok; policy', tuple(policy.shape),
+          'value', tuple(value.shape))
 
 
 def main():
@@ -61,4 +100,8 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    if '--torch' in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != '--torch']
+        main_torch(argv)
+    else:
+        main()

@@ -1,0 +1,195 @@
+"""TorchScript mirrors of the feed-forward model zoo.
+
+Portable-export backend for scripts/export_model.py --torch: rebuilds an
+architecture as a plain PyTorch module, transplants the trained flax params
+into it, numerically validates the transplant against the flax forward, and
+emits a self-contained TorchScript artifact. The resulting ``.pt`` runs
+anywhere torch does — ``torch.jit.load`` needs no handyrl_tpu (or even
+flax/jax) code — which restores the portability contract of the reference's
+ONNX export (reference scripts/make_onnx_model.py:28-58) in an image where
+no ONNX writer exists (no onnx/onnxscript/tensorflow).
+
+Layout notes (the subtle parts of the transplant):
+  * flax runs NHWC, the mirrors run native-torch NCHW. Conv kernels map
+    (kh, kw, cin, cout) -> (cout, cin, kh, kw).
+  * the flax heads flatten NHWC feature maps before their Dense layers, so
+    those Dense kernels are row-permuted from (H,W,C) order into the
+    mirror's (C,H,W) flatten order.
+  * flax GroupNorm uses eps=1e-6 (torch defaults to 1e-5): set explicitly.
+
+Supported: SimpleConv2dModel, GeeseNet (the feed-forward nets — the kaggle
+submission path). Recurrent architectures (DRC, ConvLSTM, GeeseFormer)
+export via the .jaxexp path instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+def _t(arr) -> torch.Tensor:
+    return torch.from_numpy(np.array(arr, dtype=np.float32))  # owning copy
+
+
+def _conv_kernel(kernel) -> torch.Tensor:
+    """(kh, kw, cin, cout) -> (cout, cin, kh, kw)."""
+    return _t(np.transpose(np.asarray(kernel), (3, 2, 0, 1)))
+
+
+def _dense_kernel(kernel) -> torch.Tensor:
+    """(cin, cout) -> (cout, cin)."""
+    return _t(np.asarray(kernel).T)
+
+
+def _dense_kernel_from_nhwc_flatten(kernel, h, w, c) -> torch.Tensor:
+    """Dense weight whose input was an NHWC flatten, re-ordered for an
+    NCHW flatten: rows (h,w,c) -> (c,h,w)."""
+    k = np.asarray(kernel).reshape(h, w, c, -1)
+    k = np.transpose(k, (2, 0, 1, 3)).reshape(h * w * c, -1)
+    return _t(k.T)
+
+
+class TorusConv2dMirror(nn.Module):
+    """Circular-padded 3x3 conv + GroupNorm (mirror of blocks.TorusConv)."""
+
+    def __init__(self, cin: int, cout: int):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, 3, padding=1,
+                              padding_mode='circular', bias=False)
+        self.norm = nn.GroupNorm(min(8, cout), cout, eps=1e-6)
+
+    def forward(self, x):
+        return self.norm(self.conv(x))
+
+    def load_flax(self, p):
+        self.conv.weight.data = _conv_kernel(p['Conv_0']['kernel'])
+        self.norm.weight.data = _t(p['GroupNorm_0']['scale'])
+        self.norm.bias.data = _t(p['GroupNorm_0']['bias'])
+
+
+class GeeseNetMirror(nn.Module):
+    """NCHW twin of models.geese.GeeseNet; obs (B, 17, 7, 11) -> (policy(4),
+    value(1))."""
+
+    def __init__(self, filters: int = 32, layers: int = 12):
+        super().__init__()
+        self.stem = TorusConv2dMirror(17, filters)
+        self.blocks = nn.ModuleList(
+            [TorusConv2dMirror(filters, filters) for _ in range(layers)])
+        self.policy = nn.Linear(filters, 4, bias=False)
+        self.value = nn.Linear(2 * filters, 1, bias=False)
+
+    def forward(self, obs):
+        h = torch.relu(self.stem(obs))
+        for block in self.blocks:
+            h = torch.relu(h + block(h))
+        head_mask = obs[:, :1]                      # own head plane
+        h_head = (h * head_mask).sum(dim=(2, 3))
+        h_avg = h.mean(dim=(2, 3))
+        policy = self.policy(h_head)
+        value = torch.tanh(self.value(torch.cat([h_head, h_avg], dim=1)))
+        return policy, value
+
+    def load_flax(self, params):
+        p = params['params']
+        self.stem.load_flax(p['TorusConv_0'])
+        for i, block in enumerate(self.blocks):
+            block.load_flax(p['TorusConv_%d' % (i + 1)])
+        self.policy.weight.data = _dense_kernel(p['Dense_0']['kernel'])
+        self.value.weight.data = _dense_kernel(p['Dense_1']['kernel'])
+
+
+class SimpleConv2dMirror(nn.Module):
+    """NCHW twin of models.tictactoe.SimpleConv2dModel; obs (B, 3, 3, 3) ->
+    (policy(9), value(1))."""
+
+    def __init__(self, filters: int = 32, layers: int = 3):
+        super().__init__()
+        self.stem = nn.Conv2d(3, filters, 3, padding=1)
+        self.blocks = nn.ModuleList()
+        for _ in range(layers):
+            self.blocks.append(nn.ModuleDict({
+                'conv': nn.Conv2d(filters, filters, 3, padding=1, bias=False),
+                'norm': nn.GroupNorm(min(8, filters), filters, eps=1e-6),
+            }))
+        # PolicyHead(2, 9): 1x1 squeeze -> leaky-relu(0.1) -> dense
+        self.p_squeeze = nn.Conv2d(filters, 2, 1)
+        self.p_out = nn.Linear(2 * 9, 9, bias=False)
+        # ScalarHead(1, 1): 1x1 (no bias) -> GroupNorm(1) -> relu -> dense
+        self.v_squeeze = nn.Conv2d(filters, 1, 1, bias=False)
+        self.v_norm = nn.GroupNorm(1, 1, eps=1e-6)
+        self.v_out = nn.Linear(9, 1, bias=False)
+
+    def forward(self, obs):
+        h = torch.relu(self.stem(obs))
+        for block in self.blocks:
+            h = torch.relu(block['norm'](block['conv'](h)))
+        hp = torch.nn.functional.leaky_relu(self.p_squeeze(h), 0.1)
+        policy = self.p_out(hp.flatten(1))
+        hv = torch.relu(self.v_norm(self.v_squeeze(h)))
+        value = torch.tanh(self.v_out(hv.flatten(1)))
+        return policy, value
+
+    def load_flax(self, params):
+        p = params['params']
+        self.stem.weight.data = _conv_kernel(p['Conv_0']['kernel'])
+        self.stem.bias.data = _t(p['Conv_0']['bias'])
+        for i, block in enumerate(self.blocks):
+            bp = p['ConvBlock_%d' % i]
+            block['conv'].weight.data = _conv_kernel(bp['Conv_0']['kernel'])
+            block['norm'].weight.data = _t(bp['GroupNorm_0']['scale'])
+            block['norm'].bias.data = _t(bp['GroupNorm_0']['bias'])
+        ph = p['PolicyHead_0']
+        self.p_squeeze.weight.data = _conv_kernel(ph['Conv_0']['kernel'])
+        self.p_squeeze.bias.data = _t(ph['Conv_0']['bias'])
+        self.p_out.weight.data = _dense_kernel_from_nhwc_flatten(
+            ph['Dense_0']['kernel'], 3, 3, 2)
+        sh = p['ScalarHead_0']
+        self.v_squeeze.weight.data = _conv_kernel(sh['Conv_0']['kernel'])
+        self.v_norm.weight.data = _t(sh['GroupNorm_0']['scale'])
+        self.v_norm.bias.data = _t(sh['GroupNorm_0']['bias'])
+        self.v_out.weight.data = _dense_kernel_from_nhwc_flatten(
+            sh['Dense_0']['kernel'], 3, 3, 1)
+
+
+MIRRORS = {
+    'GeeseNet': GeeseNetMirror,
+    'SimpleConv2dModel': SimpleConv2dMirror,
+}
+
+
+def export_torchscript(arch: str, params, example_obs, out_path: str,
+                       atol: float = 1e-4):
+    """Transplant ``params`` into the torch mirror of ``arch``, validate the
+    forward numerically, and save a traced TorchScript artifact."""
+    if arch not in MIRRORS:
+        raise SystemExit(
+            'no torch mirror for %r (supported: %s); recurrent nets export '
+            'via the .jaxexp path' % (arch, sorted(MIRRORS)))
+    mirror = MIRRORS[arch]()
+    mirror.load_flax(params)
+    mirror.eval()
+
+    example = torch.from_numpy(
+        np.asarray(example_obs, np.float32)[None])
+    with torch.no_grad():
+        traced = torch.jit.trace(mirror, example)
+    torch.jit.save(traced, out_path)
+    return mirror
+
+
+def validate_against_flax(mirror, wrapper, example_obs, atol=1e-4):
+    """Max abs deviation between the flax forward and the torch mirror."""
+    flax_out = wrapper.inference(example_obs, None)
+    with torch.no_grad():
+        policy, value = mirror(
+            torch.from_numpy(np.asarray(example_obs, np.float32)[None]))
+    dev = max(
+        float(np.abs(policy.numpy()[0] - np.asarray(flax_out['policy'])).max()),
+        float(np.abs(value.numpy()[0] - np.asarray(flax_out['value'])).max()))
+    if dev > atol:
+        raise SystemExit('torch mirror deviates from flax by %g (atol %g)'
+                         % (dev, atol))
+    return dev
