@@ -35,13 +35,56 @@ except ImportError:                      # pragma: no cover
 LANES = 128
 
 
+_PROBE_RESULT = None
+
+
+def _probe_on_device() -> bool:
+    """Compile and run one tiny TD(λ) kernel on the live backend and compare
+    it against the lax.scan reference. A kernel that fails to compile, or
+    compiles but disagrees, disqualifies the whole Pallas path for this
+    process — training silently falls back to the scan implementation
+    instead of faceplanting (or mis-training) on the hot path."""
+    import numpy as np
+    from . import targets as scan_ref
+    try:
+        rng = np.random.RandomState(0)
+        shape = (2, 8, 1, 1)
+        values = rng.randn(*shape).astype(np.float32)
+        returns = rng.randn(*shape).astype(np.float32)
+        rewards = rng.randn(*shape).astype(np.float32)
+        lambda_ = (0.7 + 0.3 * (rng.rand(*shape) > 0.5)).astype(np.float32)
+        got_t, got_a = td_lambda_pallas(values, returns, rewards,
+                                        lambda_, 0.9)
+        want_t, want_a = scan_ref.td_lambda(values, returns, rewards,
+                                            lambda_, 0.9)
+        ok = (np.allclose(np.asarray(got_t), np.asarray(want_t),
+                          rtol=1e-4, atol=1e-4)
+              and np.allclose(np.asarray(got_a), np.asarray(want_a),
+                              rtol=1e-4, atol=1e-4))
+        if not ok:
+            print('pallas targets probe: kernel DISAGREES with lax.scan '
+                  'on this backend; using the scan path')
+        return ok
+    except Exception as exc:   # compile/runtime failure -> scan fallback
+        print('pallas targets probe failed (%s: %s); using the scan path'
+              % (type(exc).__name__, str(exc)[:120]))
+        return False
+
+
 def use_pallas_targets() -> bool:
+    """True only on a TPU backend where the kernels have actually executed
+    and matched the reference recursion in this process (probed once)."""
+    global _PROBE_RESULT
     if not _PALLAS_OK:
         return False
     try:
-        return jax.default_backend() in ('tpu', 'axon')
+        if jax.default_backend() not in ('tpu', 'axon'):
+            return False
     except Exception:
         return False
+    if _PROBE_RESULT is None:
+        _PROBE_RESULT = _probe_on_device()
+    return _PROBE_RESULT
 
 
 # ---- kernels (refs are (T, N) or (1, N) VMEM blocks) ---------------------

@@ -72,3 +72,15 @@ def test_nonmultiple_of_128_lanes():
 
 def test_cpu_backend_does_not_select_pallas():
     assert pt.use_pallas_targets() is False  # tests run on the CPU backend
+
+
+def test_use_pallas_gate_rejects_cpu_backend():
+    """The gate requires a TPU backend before it even probes."""
+    assert pt.use_pallas_targets() is False
+
+
+def test_probe_never_raises_and_declines_off_tpu():
+    """The startup probe compiles a real (non-interpret) kernel; on a
+    backend where that cannot work it must decline gracefully, never
+    raise — the trainer falls back to the lax.scan path."""
+    assert pt._probe_on_device() is False
