@@ -1,0 +1,146 @@
+"""Run the actual DeNA/HandyRL reference on the BASELINE.md matrix configs.
+
+Head-to-head counterpart of scripts/run_benchmark_matrix.py: launches
+``/root/reference/main.py --train`` (unmodified, torch CPU) in a scratch
+directory with a config.yaml matching the given row's hyperparameters, at
+the same episode budget our rows use, parses the stdout win-rate lines (the
+reference's log format IS its metrics interface), and appends a row tagged
+``implementation: reference`` to benchmarks.jsonl.
+
+Rows: ttt-td ttt-vtrace geister   (HungryGeese is excluded: the reference
+env wraps kaggle_environments, which is not installed in this image — the
+reference cannot run that row here at all.)
+
+Usage: python scripts/run_reference_matrix.py [ROW ...] [--epochs N]
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REFERENCE = '/root/reference'
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# hyperparameters mirror scripts/run_benchmark_matrix.py ROWS; schema is the
+# reference's config.yaml (reference config.yaml:1-38)
+ROWS = {
+    'ttt-td': {
+        'env': 'TicTacToe',
+        'train': {'turn_based_training': True, 'observation': False,
+                  'gamma': 0.8, 'forward_steps': 8, 'batch_size': 64,
+                  'policy_target': 'TD', 'value_target': 'TD',
+                  'update_episodes': 200, 'minimum_episodes': 400},
+    },
+    'ttt-vtrace': {
+        'env': 'TicTacToe',
+        'train': {'turn_based_training': True, 'observation': False,
+                  'gamma': 0.8, 'forward_steps': 8, 'batch_size': 64,
+                  'policy_target': 'UPGO', 'value_target': 'VTRACE',
+                  'update_episodes': 200, 'minimum_episodes': 400},
+    },
+    'geister': {
+        'env': 'Geister',
+        'train': {'turn_based_training': True, 'observation': True,
+                  'gamma': 0.8, 'forward_steps': 16, 'burn_in_steps': 4,
+                  'batch_size': 32, 'policy_target': 'TD',
+                  'value_target': 'TD',
+                  'update_episodes': 100, 'minimum_episodes': 200},
+    },
+}
+
+_WIN_RE = re.compile(
+    r'win rate(?: \(\w+\))? = ([\d.]+) \(([\d.]+) / (\d+)\)')
+_EPOCH_RE = re.compile(r'^epoch (\d+)$')
+
+
+def _config_yaml(row, epochs):
+    train = {
+        'turn_based_training': True, 'observation': False, 'gamma': 0.8,
+        'forward_steps': 8, 'burn_in_steps': 0, 'compress_steps': 4,
+        'entropy_regularization': 0.1, 'entropy_regularization_decay': 0.1,
+        'update_episodes': 200, 'batch_size': 64, 'minimum_episodes': 400,
+        'maximum_episodes': 100000, 'epochs': epochs, 'num_batchers': 2,
+        'eval_rate': 0.1, 'worker': {'num_parallel': 6}, 'lambda': 0.7,
+        'policy_target': 'TD', 'value_target': 'TD',
+        'eval': {'opponent': ['random']}, 'seed': 0, 'restart_epoch': 0,
+    }
+    train.update(row['train'])
+    lines = ['env_args:', "    env: '%s'" % row['env'], '', 'train_args:']
+    for key, val in train.items():
+        if isinstance(val, dict):
+            lines.append('    %s:' % key)
+            for k2, v2 in val.items():
+                lines.append('        %s: %s' % (k2, json.dumps(v2)))
+        else:
+            lines.append('    %s: %s' % (key, json.dumps(val)))
+    lines += ['', 'worker_args:', "    server_address: ''",
+              '    num_parallel: 8', '']
+    return '\n'.join(lines)
+
+
+def run_row(name, epochs, deadline=3600):
+    scratch = tempfile.mkdtemp(prefix='ref_%s_' % name)
+    with open(os.path.join(scratch, 'config.yaml'), 'w') as f:
+        f.write(_config_yaml(ROWS[name], epochs))
+    log_path = os.path.join(scratch, 'train.log')
+    print('[%s] reference run in %s (epochs=%d)' % (name, scratch, epochs))
+
+    env = dict(os.environ, PYTHONPATH=REFERENCE, OMP_NUM_THREADS='1')
+    t0 = time.time()
+    with open(log_path, 'w') as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REFERENCE, 'main.py'), '--train'],
+            cwd=scratch, env=env, stdout=log, stderr=subprocess.STDOUT)
+        try:
+            proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    wall = time.time() - t0
+
+    text = open(log_path, errors='replace').read()
+    rates = [(float(m.group(1)), int(m.group(3)))
+             for m in _WIN_RE.finditer(text)]
+    epochs_seen = [int(m.group(1)) for line in text.splitlines()
+                   for m in [_EPOCH_RE.match(line)] if m] or [0]
+
+    last5 = rates[-5:]
+    games = sum(n for _, n in last5)
+    win_rate = (sum(r * n for r, n in last5) / games) if games else None
+
+    row = {
+        'implementation': 'reference', 'row': name, 'epochs': epochs,
+        'epochs_seen': max(epochs_seen), 'wall_sec': round(wall, 1),
+        'win_rate_last5': round(win_rate, 3) if win_rate is not None else None,
+        'games_last5': games, 'log': log_path,
+        'time': time.strftime('%Y-%m-%dT%H:%M:%S'),
+    }
+    with open(os.path.join(REPO, 'benchmarks.jsonl'), 'a') as f:
+        f.write(json.dumps(row) + '\n')
+    print('[%s] reference: win_rate_last5=%s games=%s wall=%.0fs'
+          % (name, row['win_rate_last5'], games, wall))
+    return row
+
+
+def main():
+    argv = sys.argv[1:]
+    epochs = 30
+    rows = []
+    for a in argv:
+        if a.startswith('--epochs='):
+            epochs = int(a.split('=')[1])
+        else:
+            rows.append(a)
+    for name in rows or ['ttt-vtrace']:
+        run_row(name, epochs)
+
+
+if __name__ == '__main__':
+    main()

@@ -20,3 +20,39 @@ if '--xla_force_host_platform_device_count' not in _flags:
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
+
+
+# ---------------------------------------------------------------------------
+# In-tree 'timeout' mark: pytest-timeout is not installable in this image, so
+# the deadlock guards on the multiprocess/socket e2e tests are enforced here
+# with a SIGALRM watchdog (tests run in the main thread). A hung test raises
+# TimeoutError instead of stalling CI until the job limit.
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        'timeout(seconds): fail the test if it runs longer than the deadline')
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    mark = item.get_closest_marker('timeout')
+    if mark is None or not hasattr(signal, 'SIGALRM'):
+        return (yield)
+    seconds = int(mark.args[0]) if mark.args else 300
+
+    def _expired(signum, frame):
+        raise TimeoutError('test exceeded %ds timeout' % seconds)
+
+    previous = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)
