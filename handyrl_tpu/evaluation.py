@@ -232,12 +232,23 @@ def build_agent(raw: str, env=None):
 
 class Evaluator:
     """Online evaluation during training: the trained model vs a configured
-    opponent pool (default 'random')."""
+    opponent pool (default 'random'). Opponent specs may be built-in agent
+    names or model checkpoint paths; checkpoint opponents are loaded once
+    and cached across matches."""
 
     def __init__(self, env, args):
         self.env = env
         self.args = args
         self.default_opponent = 'random'
+        self._opponent_cache: Dict[str, Any] = {}
+
+    def _opponent_agent(self, spec: str):
+        agent = build_agent(spec, self.env)
+        if agent is not None:
+            return agent
+        if spec not in self._opponent_cache:
+            self._opponent_cache[spec] = Agent(load_model(spec, self.env))
+        return self._opponent_cache[spec]
 
     def execute(self, models: Dict[int, Any], eval_args) -> Optional[dict]:
         opponents = self.args.get('eval', {}).get('opponent', [])
@@ -245,7 +256,7 @@ class Evaluator:
             else self.default_opponent
 
         agents = {p: Agent(model) if model is not None
-                  else build_agent(opponent, self.env)
+                  else self._opponent_agent(opponent)
                   for p, model in models.items()}
 
         results = exec_match(self.env, agents)

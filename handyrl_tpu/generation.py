@@ -248,15 +248,18 @@ class BatchedGenerator:
 
 class BatchedEvaluator:
     """Vectorized online evaluation: N concurrent matches of the trained
-    model (greedy, one rotating seat per match) against host-side opponents
-    (random / rule-based). The model seats across all matches share ONE
-    batched inference call per step, replacing the reference's sequential
-    B=1 evaluation matches (evaluation.py:159-177)."""
+    model (greedy, one rotating seat per match) against configured
+    opponents. Opponents may be host-side agents (random / rule-based) or
+    model checkpoints ('eval: opponent: [models/5.ckpt]'): every
+    model-driven seat — the trained seat and any model opponents — is
+    batched across matches, one inference call per distinct model per step.
+    The reference evaluates sequentially at B=1 (evaluation.py:159-177) and
+    has no vectorized model-vs-model path at all."""
+
+    MAIN = ''   # pool key of the trained model under evaluation
 
     def __init__(self, make_env_fn, wrapper, args: Dict[str, Any],
                  n_envs: int = 16):
-        from .agent import RandomAgent, RuleBasedAgent
-
         self.envs = [make_env_fn(i) for i in range(n_envs)]
         self.wrapper = wrapper
         self.args = args
@@ -264,17 +267,34 @@ class BatchedEvaluator:
         self._seat_counter = 0
         self._opponents = (args.get('eval', {}).get('opponent', [])
                           or ['random'])
-
-        def build_opponent(name):
-            if name.startswith('rulebase'):
-                key = name.split('-')[1] if '-' in name else None
-                return RuleBasedAgent(key)
-            return RandomAgent()
-
-        self._build_opponent = build_opponent
+        self._model_pool: Dict[str, Any] = {self.MAIN: wrapper}
+        # preload model opponents NOW: load_model resets the env it probes,
+        # which must never happen once matches are in flight
+        for spec in self._opponents:
+            if self._host_agent(spec) is None:
+                self._opponent_model(spec)
         self._slot_state: List[dict] = [None] * n_envs
         for i in range(n_envs):
             self._start_match(i)
+
+    def _host_agent(self, name: str):
+        """Host-side opponent for a spec name, or None if it names a model
+        (same parser the worker-mode Evaluator uses)."""
+        from .evaluation import build_agent
+        return build_agent(name, self.envs[0])
+
+    def _opponent_model(self, path: str):
+        """Load (once) a checkpoint-file opponent into the model pool."""
+        if path not in self._model_pool:
+            from .evaluation import load_model
+            model = load_model(path, self.envs[0])
+            if not hasattr(model, 'batch_inference'):
+                raise ValueError(
+                    'evaluator model opponents must be .ckpt checkpoints '
+                    '(batched inference); %r loads as %s'
+                    % (path, type(model).__name__))
+            self._model_pool[path] = model
+        return self._model_pool[path]
 
     def _start_match(self, i: int):
         env = self.envs[i]
@@ -283,62 +303,81 @@ class BatchedEvaluator:
         seat = players[self._seat_counter % len(players)]
         self._seat_counter += 1
         opponent = random.choice(self._opponents)
-        self._slot_state[i] = {
-            'seat': seat,
-            'opponent': opponent,
-            'agents': {p: self._build_opponent(opponent)
-                       for p in players if p != seat},
-            'hidden': self.wrapper.init_hidden(),
-        }
+
+        agents: Dict[int, Any] = {}
+        model_seats: Dict[int, dict] = {
+            seat: {'key': self.MAIN, 'hidden': self.wrapper.init_hidden()}}
+        for p in players:
+            if p == seat:
+                continue
+            agent = self._host_agent(opponent)
+            if agent is not None:
+                agents[p] = agent
+            else:
+                opp = self._opponent_model(opponent)
+                model_seats[p] = {'key': opponent,
+                                  'hidden': opp.init_hidden()}
+        self._slot_state[i] = {'seat': seat, 'opponent': opponent,
+                               'agents': agents, 'model_seats': model_seats}
+
+    def _batched_actions(self, jobs: List[tuple]) -> Dict[tuple, int]:
+        """Greedy actions for (env_idx, player) model seats sharing one
+        model: a single padded batch_inference call."""
+        if not jobs:
+            return {}
+        key = self._slot_state[jobs[0][0]]['model_seats'][jobs[0][1]]['key']
+        model = self._model_pool[key]
+        rows = len(jobs)
+        bucket = max(8, 1 << (rows - 1).bit_length())
+        pad = bucket - rows
+
+        def pad_rows(x):
+            if pad == 0:
+                return x
+            return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
+
+        obs_batch = map_structure(pad_rows, stack_structure(
+            [self.envs[i].observation(p) for i, p in jobs]))
+        seats = [self._slot_state[i]['model_seats'][p] for i, p in jobs]
+        hidden_batch = None
+        if seats[0]['hidden'] is not None:
+            hidden_batch = map_structure(pad_rows, stack_structure(
+                [s['hidden'] for s in seats]))
+        outputs = model.batch_inference(obs_batch, hidden_batch)
+        policies = np.asarray(outputs['policy'])
+        next_hidden = outputs.get('hidden', None)
+
+        actions: Dict[tuple, int] = {}
+        for row, (i, p) in enumerate(jobs):
+            if next_hidden is not None:
+                seats[row]['hidden'] = map_structure(
+                    lambda a: np.asarray(a)[row], next_hidden)
+            legal = self.envs[i].legal_actions(p)
+            logits = policies[row]
+            actions[(i, p)] = max(legal, key=lambda a: logits[a])  # greedy
+        return actions
 
     def step(self) -> List[dict]:
         """Advance all matches one step; returns finished result records."""
-        jobs = []    # (env_idx, obs) for model seats to act
+        # group due model seats by model, one batched call per model
+        due: Dict[str, List[tuple]] = {}
         for i, env in enumerate(self.envs):
             st = self._slot_state[i]
-            if st['seat'] in env.turns():
-                jobs.append((i, env.observation(st['seat'])))
-
-        policies = None
-        next_hidden = None
-        if jobs:
-            rows = len(jobs)
-            bucket = max(8, 1 << (rows - 1).bit_length())
-            pad = bucket - rows
-
-            def pad_rows(x):
-                if pad == 0:
-                    return x
-                return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
-
-            obs_batch = map_structure(pad_rows,
-                                      stack_structure([j[1] for j in jobs]))
-            hidden_batch = None
-            if self._slot_state[jobs[0][0]]['hidden'] is not None:
-                hidden_batch = map_structure(pad_rows, stack_structure(
-                    [self._slot_state[i]['hidden'] for i, _ in jobs]))
-            outputs = self.wrapper.batch_inference(obs_batch, hidden_batch)
-            policies = np.asarray(outputs['policy'])
-            next_hidden = outputs.get('hidden', None)
-
-        model_actions: Dict[int, int] = {}
-        for row, (i, _) in enumerate(jobs):
-            env = self.envs[i]
-            st = self._slot_state[i]
-            if next_hidden is not None:
-                st['hidden'] = map_structure(lambda a: np.asarray(a)[row],
-                                             next_hidden)
-            legal = env.legal_actions(st['seat'])
-            p = policies[row]
-            model_actions[i] = max(legal, key=lambda a: p[a])   # greedy
+            for p in env.turns():
+                seat_info = st['model_seats'].get(p)
+                if seat_info is not None:
+                    due.setdefault(seat_info['key'], []).append((i, p))
+        model_actions: Dict[tuple, int] = {}
+        for jobs in due.values():
+            model_actions.update(self._batched_actions(jobs))
 
         finished = []
         for i, env in enumerate(self.envs):
             st = self._slot_state[i]
             actions = {}
             for p in env.turns():
-                if p == st['seat']:
-                    actions[p] = model_actions.get(i)
+                if p in st['model_seats']:
+                    actions[p] = model_actions.get((i, p))
                 else:
                     actions[p] = st['agents'][p].action(env, p)
             err = env.step(actions)

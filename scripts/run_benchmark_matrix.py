@@ -99,9 +99,12 @@ def main():
         jax.config.update('jax_platforms', 'cpu')
     epochs = 10
     rows = []
-    for a in sys.argv[1:]:
+    argv = iter(sys.argv[1:])
+    for a in argv:
         if a.startswith('--epochs='):
             epochs = int(a.split('=', 1)[1])
+        elif a == '--epochs':
+            epochs = int(next(argv))
         elif a in ROWS:
             rows.append(a)
         else:

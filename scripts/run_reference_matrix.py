@@ -115,29 +115,37 @@ def run_row(name, epochs, deadline=3600):
     games = sum(n for _, n in last5)
     win_rate = (sum(r * n for r, n in last5) / games) if games else None
 
+    # field names match the rows run_benchmark_matrix.py writes, so one
+    # read of benchmarks.jsonl compares both implementations directly
     row = {
         'implementation': 'reference', 'row': name, 'epochs': epochs,
-        'epochs_seen': max(epochs_seen), 'wall_sec': round(wall, 1),
-        'win_rate_last5': round(win_rate, 3) if win_rate is not None else None,
-        'games_last5': games, 'log': log_path,
-        'time': time.strftime('%Y-%m-%dT%H:%M:%S'),
+        'epochs_seen': max(epochs_seen), 'wall_s': round(wall, 1),
+        'win_rate_vs_random_last5': (round(win_rate, 3)
+                                     if win_rate is not None else None),
+        'eval_games': games, 'log': log_path,
+        'time': time.strftime('%Y-%m-%d %H:%M:%S'),
     }
     with open(os.path.join(REPO, 'benchmarks.jsonl'), 'a') as f:
         f.write(json.dumps(row) + '\n')
-    print('[%s] reference: win_rate_last5=%s games=%s wall=%.0fs'
-          % (name, row['win_rate_last5'], games, wall))
+    print('[%s] reference: win_rate_vs_random_last5=%s games=%s wall=%.0fs'
+          % (name, row['win_rate_vs_random_last5'], games, wall))
     return row
 
 
 def main():
-    argv = sys.argv[1:]
+    argv = iter(sys.argv[1:])
     epochs = 30
     rows = []
     for a in argv:
         if a.startswith('--epochs='):
-            epochs = int(a.split('=')[1])
-        else:
+            epochs = int(a.split('=', 1)[1])
+        elif a == '--epochs':
+            epochs = int(next(argv))
+        elif a in ROWS:
             rows.append(a)
+        else:
+            raise SystemExit('unknown row %r (choose from %s, or --epochs N)'
+                             % (a, sorted(ROWS)))
     for name in rows or ['ttt-vtrace']:
         run_row(name, epochs)
 
