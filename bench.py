@@ -114,6 +114,39 @@ def peak_flops(device_kind: str) -> float:
     return 0.0
 
 
+def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3):
+    """AOT-compile ``step_fn`` and time ``steps`` executions.
+
+    The batch is materialized on device FIRST so the timed loop measures
+    compute, not per-step host-to-device transfer (``jnp.asarray`` is a
+    no-op for arrays that already live on device, so pre-sharded batches
+    keep their shardings). Returns (seconds_per_step, flops_per_step);
+    flops come from XLA's own cost analysis of the same executable, 0.0
+    if the AOT path is unavailable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    batch = jax.tree_util.tree_map(jnp.asarray, batch)
+    flops = 0.0
+    try:
+        compiled = step_fn.lower(state, batch, lr).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float((cost or {}).get('flops', 0.0))
+    except Exception:
+        compiled = step_fn   # jitted callable; flops stay unreported
+    for _ in range(warmup):
+        state, metrics = compiled(state, batch, lr)
+    jax.block_until_ready(metrics['total'])
+    t0 = time.time()
+    for _ in range(steps):
+        state, metrics = compiled(state, batch, lr)
+    jax.block_until_ready(metrics['total'])
+    return (time.time() - t0) / steps, flops
+
+
 def run_bench(probe: dict):
     import jax
     plat = os.environ.get('JAX_PLATFORMS')
@@ -146,29 +179,10 @@ def run_bench(probe: dict):
         batch = shard_batch(mesh, batch)
     lr = jnp.asarray(1e-5, jnp.float32)
 
-    # AOT-compile once; the same executable serves the cost analysis (XLA's
-    # own FLOP count) and the timed loop — no second trace/compile.
-    flops_per_step = 0.0
-    try:
-        step = step.lower(state, batch, lr).compile()
-        cost = step.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float((cost or {}).get('flops', 0.0))
-    except Exception:
-        pass   # fall back to the jitted callable; flops stay unreported
-
-    # warmup
-    for _ in range(3):
-        state, metrics = step(state, batch, lr)
-    jax.block_until_ready(metrics['total'])
-
-    t0 = time.time()
-    for _ in range(steps):
-        state, metrics = step(state, batch, lr)
-    jax.block_until_ready(metrics['total'])
-    dt = time.time() - t0
-    traj_per_sec = B * steps / dt
+    sec_per_step, flops_per_step = time_compiled_step(
+        step, state, batch, lr, steps)
+    dt = sec_per_step * steps
+    traj_per_sec = B / sec_per_step
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              'bench_baseline.json')

@@ -11,15 +11,23 @@ zero intermediate HBM traffic.
 Gradients never flow through targets (they consume stop_gradient'd values —
 losses.py), so no custom VJP is needed; callers get stop_gradient semantics.
 
-Used automatically on TPU backends (see ``use_pallas_targets``); the
-``lax.scan`` path in ops/targets.py remains the reference implementation and
-the fallback everywhere else. ``interpret=True`` makes the same kernels
-testable on CPU.
+Status (measured on a real TPU v5e chip, round 2): the kernels compile,
+run, and agree with the scan reference on silicon (tests/test_pallas_targets.py
+with HANDYRL_TPU_TESTS=1), but inside the full update step they are SLOWER
+than the lax.scan path — 56.9 vs 51.4 ms/step for TD/TD and 110.7 vs 50.0
+for UPGO/VTRACE at B=128 T=16 (BENCHMARKS.md). The recursion is elementwise
+on tiny (T, B·P) blocks, so XLA fuses the scan into the surrounding program,
+while a pallas_call is an opaque custom call that forces its inputs to be
+materialized and breaks fusion. The scan path is therefore the default on
+every backend; set ``HANDYRL_PALLAS_TARGETS=1`` to opt in (the startup
+probe still verifies the kernel against the scan before enabling it).
+``interpret=True`` makes the same kernels testable on CPU.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -38,6 +46,19 @@ LANES = 128
 _PROBE_RESULT = None
 
 
+def _trace_state_clean() -> bool:
+    """True when no jit/vmap/etc. trace is active (safe to run the probe)."""
+    try:
+        from jax._src.core import trace_state_clean
+        return bool(trace_state_clean())
+    except Exception:
+        # Private API moved: assume clean. Worst case the probe runs
+        # mid-trace, fails loudly inside its own try/except, and the gate
+        # stays closed — whereas returning False here would silently turn
+        # the explicit opt-in into a no-op.
+        return True
+
+
 def _probe_on_device() -> bool:
     """Compile and run one tiny TD(λ) kernel on the live backend and compare
     it against the lax.scan reference. A kernel that fails to compile, or
@@ -47,35 +68,51 @@ def _probe_on_device() -> bool:
     import numpy as np
     from . import targets as scan_ref
     try:
-        rng = np.random.RandomState(0)
-        shape = (2, 8, 1, 1)
-        values = rng.randn(*shape).astype(np.float32)
-        returns = rng.randn(*shape).astype(np.float32)
-        rewards = rng.randn(*shape).astype(np.float32)
-        lambda_ = (0.7 + 0.3 * (rng.rand(*shape) > 0.5)).astype(np.float32)
-        got_t, got_a = td_lambda_pallas(values, returns, rewards,
-                                        lambda_, 0.9)
-        want_t, want_a = scan_ref.td_lambda(values, returns, rewards,
-                                            lambda_, 0.9)
-        ok = (np.allclose(np.asarray(got_t), np.asarray(want_t),
-                          rtol=1e-4, atol=1e-4)
-              and np.allclose(np.asarray(got_a), np.asarray(want_a),
-                              rtol=1e-4, atol=1e-4))
-        if not ok:
-            print('pallas targets probe: kernel DISAGREES with lax.scan '
-                  'on this backend; using the scan path')
-        return ok
+        return _probe_body(np, scan_ref)
     except Exception as exc:   # compile/runtime failure -> scan fallback
         print('pallas targets probe failed (%s: %s); using the scan path'
               % (type(exc).__name__, str(exc)[:120]))
         return False
 
 
+def _probe_body(np, scan_ref) -> bool:
+    rng = np.random.RandomState(0)
+    shape = (2, 8, 1, 1)
+    values = rng.randn(*shape).astype(np.float32)
+    returns = rng.randn(*shape).astype(np.float32)
+    rewards = rng.randn(*shape).astype(np.float32)
+    lambda_ = (0.7 + 0.3 * (rng.rand(*shape) > 0.5)).astype(np.float32)
+    got_t, got_a = td_lambda_pallas(values, returns, rewards,
+                                    lambda_, 0.9)
+    want_t, want_a = scan_ref.td_lambda(values, returns, rewards,
+                                        lambda_, 0.9)
+    ok = (np.allclose(np.asarray(got_t), np.asarray(want_t),
+                      rtol=1e-4, atol=1e-4)
+          and np.allclose(np.asarray(got_a), np.asarray(want_a),
+                          rtol=1e-4, atol=1e-4))
+    if not ok:
+        print('pallas targets probe: kernel DISAGREES with lax.scan '
+              'on this backend; using the scan path')
+    return ok
+
+
 def use_pallas_targets() -> bool:
-    """True only on a TPU backend where the kernels have actually executed
-    and matched the reference recursion in this process (probed once)."""
+    """True only when explicitly opted in (HANDYRL_PALLAS_TARGETS=1), on a
+    TPU backend, where the kernels have actually executed and matched the
+    reference recursion in this process (probed once). Off by default: the
+    scan path measured faster inside the full update step (module docstring).
+
+    The probe must run OUTSIDE any jit trace (it compiles and executes a
+    real kernel); step builders call this eagerly before tracing
+    (ops/train_step.py). If the first call nevertheless lands mid-trace,
+    we answer False for that trace rather than probing — safe fallback,
+    never a crash."""
     global _PROBE_RESULT
     if not _PALLAS_OK:
+        return False
+    if os.environ.get('HANDYRL_PALLAS_TARGETS') != '1':
+        return False
+    if _PROBE_RESULT is None and not _trace_state_clean():
         return False
     try:
         if jax.default_backend() not in ('tpu', 'axon'):

@@ -119,8 +119,10 @@ def compute_target(algorithm: str, values: Optional[Array], returns: Array,
     """Dispatch on algorithm name; mirrors losses.py:63-78 including the
     no-baseline Monte-Carlo fallback and the lambda-mask collapse.
 
-    On TPU backends the backward recursion runs as a single fused Pallas
-    kernel (ops/pallas_targets.py); elsewhere as lax.scan."""
+    The backward recursion runs as lax.scan by default on every backend
+    (measured faster than the Pallas kernels inside the full update step —
+    ops/pallas_targets.py module docstring); HANDYRL_PALLAS_TARGETS=1 plus
+    a passing on-device probe switches TPU backends to the fused kernels."""
     if values is None:
         return returns, returns
     if algorithm == 'MC':

@@ -49,6 +49,12 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
     ``metrics`` carries the per-term loss sums and the turn count of the
     batch (the reference's ``dcnt``) as device scalars.
     """
+    # Resolve the Pallas-vs-scan target path NOW, outside any trace: the
+    # probe compiles and runs a real kernel on the backend, which cannot
+    # happen once tracing of ``update`` has begun.
+    from .pallas_targets import use_pallas_targets
+    use_pallas_targets()
+
     optimizer = make_optimizer()
     apply_fn = module.apply
 

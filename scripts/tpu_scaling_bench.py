@@ -1,0 +1,66 @@
+"""Batch-size scaling of the compiled update step on the real TPU chip.
+
+Measures the full update step (forward + targets + losses + grads + Adam)
+on GeeseNet at T=16 across a sweep of batch sizes, reporting step time,
+trajectories/sec, and MFU per row. Companion to bench.py (which pins the
+reference geometry B=128); this sweep shows where the chip saturates.
+
+Usage: python scripts/tpu_scaling_bench.py [B ...]   (default sweep below)
+Appends rows tagged ``row: tpu-scaling`` to benchmarks.jsonl.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from handyrl_tpu.models import build
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
+    from __graft_entry__ import _synthetic_batch
+    from bench import peak_flops, time_compiled_step
+
+    sizes = [int(a) for a in sys.argv[1:] if a.isdigit()] or \
+        [64, 128, 256, 512, 1024, 2048]
+    T, steps = 16, 20
+
+    module = build('GeeseNet')
+    rng = np.random.RandomState(0)
+    dev = jax.devices()[0]
+    peak = peak_flops(dev.device_kind)
+    cfg = LossConfig(turn_based_training=False, observation=True,
+                     policy_target='TD', value_target='TD', gamma=0.99)
+    step_fn = build_update_step(module, cfg, mesh=None, donate=False)
+    lr = jnp.asarray(1e-5, jnp.float32)
+
+    out_path = os.path.join(REPO, 'benchmarks.jsonl')
+    for B in sizes:
+        batch = _synthetic_batch(B, T, 1, (17, 7, 11), 4, rng)
+        params = module.init(jax.random.PRNGKey(0),
+                             batch['observation'][:, 0, 0], None)
+        state = init_train_state(params)
+        dt, flops = time_compiled_step(step_fn, state, batch, lr, steps)
+        row = {'row': 'tpu-scaling', 'device': dev.device_kind, 'B': B,
+               'T': T, 'step_ms': round(dt * 1e3, 2),
+               'traj_per_sec': round(B / dt, 1),
+               'flops_per_step': flops,
+               'mfu': round(flops / dt / peak, 4) if peak else 0.0,
+               'time': time.strftime('%Y-%m-%dT%H:%M')}
+        print(json.dumps(row), flush=True)
+        # append per row: a crash/OOM at a larger B keeps earlier results
+        with open(out_path, 'a') as f:
+            f.write(json.dumps(row) + '\n')
+
+
+if __name__ == '__main__':
+    main()

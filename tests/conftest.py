@@ -12,14 +12,21 @@ off-device (so it can run in parallel with a training/bench process).
 
 import os
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
-_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in _flags:
-    os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+# Opt-in real-device run: HANDYRL_TPU_TESTS=1 keeps whatever backend the
+# environment provides, so device-gated tests (e.g. the compiled Pallas
+# kernels in test_pallas_targets.py) exercise real silicon. Default stays
+# the virtual 8-device CPU mesh.
+if os.environ.get('HANDYRL_TPU_TESTS') == '1':
+    import jax
+else:
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    _flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in _flags:
+        os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_platforms', 'cpu')
 
 
 # ---------------------------------------------------------------------------

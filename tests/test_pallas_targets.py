@@ -1,5 +1,13 @@
-"""Pallas target kernels vs the lax.scan reference (interpret mode on CPU)."""
+"""Pallas target kernels vs the lax.scan reference.
 
+Default suite run (CPU conftest pin): kernels execute in interpret mode.
+With ``HANDYRL_TPU_TESTS=1`` and a live TPU backend, every parity test ALSO
+runs the genuinely compiled kernels on silicon (interpret=False) — this is
+the VERDICT-mandated proof that the Pallas path works as Pallas, not only
+as its interpreter.
+"""
+
+import jax
 import numpy as np
 import pytest
 
@@ -8,6 +16,17 @@ from handyrl_tpu.ops import pallas_targets as pt
 
 B, T, P = 4, 16, 2
 SHAPE = (B, T, P, 1)
+
+_ON_TPU = jax.default_backend() in ('tpu', 'axon')
+
+# interpret=True runs anywhere; interpret=False only compiles on real TPU
+INTERPRET_MODES = [True] + ([False] if _ON_TPU else [])
+
+
+@pytest.fixture(params=INTERPRET_MODES,
+                ids=['interpret', 'compiled'][:len(INTERPRET_MODES)])
+def interpret(request):
+    return request.param
 
 
 def _rand(seed):
@@ -24,39 +43,39 @@ def _rand(seed):
 
 @pytest.mark.parametrize('gamma', [1.0, 0.8])
 @pytest.mark.parametrize('use_rewards', [True, False])
-def test_td_pallas_matches_scan(gamma, use_rewards):
+def test_td_pallas_matches_scan(gamma, use_rewards, interpret):
     values, returns, rewards, _, _, lambda_ = _rand(0)
     rew = rewards if use_rewards else None
     want_t, want_a = ref.td_lambda(values, returns, rew, lambda_, gamma)
     got_t, got_a = pt.td_lambda_pallas(values, returns, rew, lambda_, gamma,
-                                       interpret=True)
+                                       interpret=interpret)
     np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(got_a), np.asarray(want_a),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_upgo_pallas_matches_scan():
+def test_upgo_pallas_matches_scan(interpret):
     values, returns, rewards, _, _, lambda_ = _rand(1)
     want_t, _ = ref.upgo(values, returns, rewards, lambda_, 0.9)
     got_t, _ = pt.upgo_pallas(values, returns, rewards, lambda_, 0.9,
-                              interpret=True)
+                              interpret=interpret)
     np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_vtrace_pallas_matches_scan():
+def test_vtrace_pallas_matches_scan(interpret):
     values, returns, rewards, rhos, cs, lambda_ = _rand(2)
     want_v, want_a = ref.vtrace(values, returns, rewards, lambda_, 0.9, rhos, cs)
     got_v, got_a = pt.vtrace_pallas(values, returns, rewards, lambda_, 0.9,
-                                    rhos, cs, interpret=True)
+                                    rhos, cs, interpret=interpret)
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(got_a), np.asarray(want_a),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_nonmultiple_of_128_lanes():
+def test_nonmultiple_of_128_lanes(interpret):
     """B*P = 6 forces lane padding."""
     rng = np.random.RandomState(3)
     shape = (3, 5, 2, 1)
@@ -65,22 +84,30 @@ def test_nonmultiple_of_128_lanes():
     lambda_ = np.full(shape, 0.7, np.float32)
     want_t, _ = ref.td_lambda(values, returns, None, lambda_, 0.9)
     got_t, _ = pt.td_lambda_pallas(values, returns, None, lambda_, 0.9,
-                                   interpret=True)
+                                   interpret=interpret)
     np.testing.assert_allclose(np.asarray(got_t), np.asarray(want_t),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_cpu_backend_does_not_select_pallas():
-    assert pt.use_pallas_targets() is False  # tests run on the CPU backend
-
-
-def test_use_pallas_gate_rejects_cpu_backend():
-    """The gate requires a TPU backend before it even probes."""
+def test_gate_closed_without_opt_in(monkeypatch):
+    """Scan is the default everywhere (measured faster on TPU; module
+    docstring) — the gate only opens with HANDYRL_PALLAS_TARGETS=1."""
+    monkeypatch.delenv('HANDYRL_PALLAS_TARGETS', raising=False)
     assert pt.use_pallas_targets() is False
 
 
+@pytest.mark.skipif(_ON_TPU, reason='probe legitimately passes on TPU')
 def test_probe_never_raises_and_declines_off_tpu():
     """The startup probe compiles a real (non-interpret) kernel; on a
     backend where that cannot work it must decline gracefully, never
     raise — the trainer falls back to the lax.scan path."""
     assert pt._probe_on_device() is False
+
+
+@pytest.mark.skipif(not _ON_TPU, reason='needs a live TPU backend')
+def test_probe_passes_and_gate_opens_on_tpu(monkeypatch):
+    """On real silicon the startup probe must compile, run, and agree
+    with the scan reference — and the gate opens once opted in."""
+    monkeypatch.setenv('HANDYRL_PALLAS_TARGETS', '1')
+    assert pt._probe_on_device() is True
+    assert pt.use_pallas_targets() is True
