@@ -5,7 +5,9 @@ on GeeseNet at T=16 across a sweep of batch sizes, reporting step time,
 trajectories/sec, and MFU per row. Companion to bench.py (which pins the
 reference geometry B=128); this sweep shows where the chip saturates.
 
-Usage: python scripts/tpu_scaling_bench.py [B ...]   (default sweep below)
+Usage: python scripts/tpu_scaling_bench.py [B ...] [--bf16]
+(default sweep below; --bf16 clones the net with bfloat16 activations —
+params stay float32, the learner's ``compute_dtype: bfloat16`` mode)
 Appends rows tagged ``row: tpu-scaling`` to benchmarks.jsonl.
 """
 
@@ -22,36 +24,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def main():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
-    from handyrl_tpu.models import build
-    from handyrl_tpu.ops.losses import LossConfig
-    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
-    from __graft_entry__ import _synthetic_batch
-    from bench import peak_flops, time_compiled_step
+    from handyrl_tpu.ops.train_step import build_update_step
+    from bench import headline_setup, peak_flops, time_compiled_step
 
+    bf16 = '--bf16' in sys.argv
     sizes = [int(a) for a in sys.argv[1:] if a.isdigit()] or \
         [64, 128, 256, 512, 1024, 2048]
     T, steps = 16, 20
 
-    module = build('GeeseNet')
-    rng = np.random.RandomState(0)
     dev = jax.devices()[0]
     peak = peak_flops(dev.device_kind)
-    cfg = LossConfig(turn_based_training=False, observation=True,
-                     policy_target='TD', value_target='TD', gamma=0.99)
-    step_fn = build_update_step(module, cfg, mesh=None, donate=False)
     lr = jnp.asarray(1e-5, jnp.float32)
+    step_fn = None
 
     out_path = os.path.join(REPO, 'benchmarks.jsonl')
     for B in sizes:
-        batch = _synthetic_batch(B, T, 1, (17, 7, 11), 4, rng)
-        params = module.init(jax.random.PRNGKey(0),
-                             batch['observation'][:, 0, 0], None)
-        state = init_train_state(params)
+        module, cfg, batch, state = headline_setup(
+            B, T, dtype=jnp.bfloat16 if bf16 else None)
+        if step_fn is None:
+            step_fn = build_update_step(module, cfg, mesh=None, donate=False)
         dt, flops = time_compiled_step(step_fn, state, batch, lr, steps)
         row = {'row': 'tpu-scaling', 'device': dev.device_kind, 'B': B,
-               'T': T, 'step_ms': round(dt * 1e3, 2),
+               'T': T, 'dtype': 'bfloat16' if bf16 else 'float32',
+               'step_ms': round(dt * 1e3, 2),
                'traj_per_sec': round(B / dt, 1),
                'flops_per_step': flops,
                'mfu': round(flops / dt / peak, 4) if peak else 0.0,

@@ -96,6 +96,17 @@ def test_gate_closed_without_opt_in(monkeypatch):
     assert pt.use_pallas_targets() is False
 
 
+@pytest.mark.skipif(_ON_TPU, reason='backend guard legitimately passes on TPU')
+def test_gate_rejects_non_tpu_backend_even_when_opted_in(monkeypatch):
+    """With the env opt-in set, a non-TPU backend must still be refused
+    BEFORE the probe runs (the real-kernel probe cannot work there)."""
+    monkeypatch.setenv('HANDYRL_PALLAS_TARGETS', '1')
+    monkeypatch.setattr(pt, '_PROBE_RESULT', None)
+    assert pt.use_pallas_targets() is False
+    # the probe must not have been attempted (it would have cached a result)
+    assert pt._PROBE_RESULT is None
+
+
 @pytest.mark.skipif(_ON_TPU, reason='probe legitimately passes on TPU')
 def test_probe_never_raises_and_declines_off_tpu():
     """The startup probe compiles a real (non-interpret) kernel; on a
