@@ -41,6 +41,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'device_generation': False,   # fully device-resident rollouts (envs with a pure-JAX twin)
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
     'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)
+    'replay_fused_steps': 8,      # SGD steps fused into one device program in device_replay mode
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id

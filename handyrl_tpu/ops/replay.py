@@ -25,16 +25,43 @@ import jax
 import jax.numpy as jnp
 
 
-class DeviceReplay:
-    """Fixed-capacity ring of training windows in device memory."""
+def recency_slots(key, size, cursor, capacity: int, batch_size: int):
+    """Draw ``batch_size`` ring slots with the reference's recency bias.
 
-    def __init__(self, capacity: int):
+    P(i) ~ (i+1) for buffer index i in [0, size), newest most likely
+    (reference train.py:291-297), via the closed-form inverse CDF of the
+    triangular weighting: i = floor(sqrt(u) * size). Traceable — used both
+    by DeviceReplay.sample and inside the fused multi-step trainer's scan
+    (ops/train_step.py), so the replay distribution has exactly one
+    definition.
+    """
+    u = jax.random.uniform(key, (batch_size,))
+    idx = jnp.minimum((jnp.sqrt(u) * size).astype(jnp.int32), size - 1)
+    # ring order: oldest window sits at cursor when full
+    start = jnp.where(size >= capacity, cursor, 0)
+    return (start + idx) % capacity
+
+
+class DeviceReplay:
+    """Fixed-capacity ring of training windows in device memory.
+
+    With a ``mesh``, the ring lives replicated across the mesh devices so
+    the fused multi-step trainer (ops/train_step.py build_replay_update)
+    can gather batches from a local replica with no per-dispatch resharding;
+    each device then computes its 'data' shard of the batch.
+    """
+
+    def __init__(self, capacity: int, mesh=None):
         self.capacity = capacity
         self.buffers: Dict[str, Any] = {}
         self.cursor = 0
         self.size = 0
+        self.mesh = mesh
+        self._repl = None
+        if mesh is not None:
+            from ..parallel.mesh import replicated_sharding
+            self._repl = replicated_sharding(mesh)
 
-        @jax.jit
         def _write(buffers, windows, cursor):
             n = jax.tree_util.tree_leaves(windows)[0].shape[0]
             idx = (cursor + jnp.arange(n)) % self.capacity
@@ -44,16 +71,14 @@ class DeviceReplay:
 
             return jax.tree_util.tree_map(put, buffers, windows)
 
+        if mesh is None:
+            _write = jax.jit(_write)
+        else:
+            _write = jax.jit(_write, out_shardings=self._repl)
+
         @partial(jax.jit, static_argnames=('batch_size',))
         def _sample(buffers, key, size, cursor, batch_size):
-            # recency-biased index draw: P(i) ~ (i+1) for i in [0, size)
-            # inverse CDF of the triangular weighting: i = floor(sqrt(u)*size)
-            u = jax.random.uniform(key, (batch_size,))
-            recency = jnp.sqrt(u)
-            idx = jnp.minimum((recency * size).astype(jnp.int32), size - 1)
-            # ring order: oldest window sits at cursor when full
-            start = jnp.where(size >= self.capacity, cursor, 0)
-            slots = (start + idx) % self.capacity
+            slots = recency_slots(key, size, cursor, capacity, batch_size)
             return jax.tree_util.tree_map(lambda b: b[slots], buffers)
 
         self._write_fn = _write

@@ -43,19 +43,8 @@ def init_train_state(params) -> TrainState:
                       steps=jnp.zeros((), jnp.int32))
 
 
-def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
-    """Returns update(state, batch, lr) -> (state, metrics), jit-compiled.
-
-    ``metrics`` carries the per-term loss sums and the turn count of the
-    batch (the reference's ``dcnt``) as device scalars.
-    """
-    # Resolve the Pallas-vs-scan target path NOW, outside any trace: the
-    # probe compiles and runs a real kernel on the backend, which cannot
-    # happen once tracing of ``update`` has begun.
-    from .pallas_targets import use_pallas_targets
-    use_pallas_targets()
-
-    optimizer = make_optimizer()
+def _update_core(module, cfg: LossConfig, optimizer):
+    """The un-jitted single SGD step shared by every compiled variant."""
     apply_fn = module.apply
 
     def init_hidden_for(batch):
@@ -81,6 +70,23 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
                                steps=state.steps + 1)
         return new_state, metrics
 
+    return update
+
+
+def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
+    """Returns update(state, batch, lr) -> (state, metrics), jit-compiled.
+
+    ``metrics`` carries the per-term loss sums and the turn count of the
+    batch (the reference's ``dcnt``) as device scalars.
+    """
+    # Resolve the Pallas-vs-scan target path NOW, outside any trace: the
+    # probe compiles and runs a real kernel on the backend, which cannot
+    # happen once tracing of ``update`` has begun.
+    from .pallas_targets import use_pallas_targets
+    use_pallas_targets()
+
+    update = _update_core(module, cfg, make_optimizer())
+
     if mesh is None:
         return jax.jit(update, donate_argnums=(0,) if donate else ())
 
@@ -91,4 +97,62 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
         in_shardings=(repl, data, repl),
         out_shardings=(repl, repl),
         donate_argnums=(0,) if donate else (),
+    )
+
+
+def build_replay_update(module, cfg: LossConfig, capacity: int,
+                        batch_size: int, num_steps: int,
+                        default_lr: float = 3e-8, mesh=None):
+    """Fused replay-mode trainer: K SGD steps in ONE compiled program.
+
+    The per-step host round trip (sample dispatch + update dispatch + PRNG
+    split) is what bounds replay-mode throughput on a dispatch-latency-heavy
+    backend (a tunneled TPU pays it ~3x per step). Here the whole inner loop
+    moves on device: a ``lax.scan`` of ``num_steps`` iterations, each drawing
+    a recency-biased batch straight from the HBM ring (same inverse-CDF as
+    DeviceReplay.sample), computing the EMA learning-rate schedule from the
+    on-device step counter (identical to Trainer._lr: steps is the count of
+    completed updates), and applying the update. Metrics come back as sums
+    over the K steps, matching what the host accumulator expects.
+
+    Returns fused(state, buffers, key, size, cursor, data_cnt_ema) ->
+    (state, key, summed_metrics). The key is carried through and returned so
+    steady-state training needs zero host-side PRNG dispatches. On a mesh the
+    ring is replicated and each sampled batch is sharding-constrained along
+    'data', so XLA runs the same data-parallel step as build_update_step.
+    """
+    from .pallas_targets import use_pallas_targets
+    use_pallas_targets()
+    from .replay import recency_slots
+
+    update = _update_core(module, cfg, make_optimizer())
+    data = batch_sharding(mesh) if mesh is not None else None
+
+    def fused(state: TrainState, buffers, key, size, cursor, data_cnt_ema):
+        def body(carry, _):
+            state, key = carry
+            key, sub = jax.random.split(key)
+            slots = recency_slots(sub, size, cursor, capacity, batch_size)
+            batch = jax.tree_util.tree_map(lambda b: b[slots], buffers)
+            if data is not None:
+                batch = jax.lax.with_sharding_constraint(
+                    batch, jax.tree_util.tree_map(lambda _: data, batch))
+            lr = (default_lr * data_cnt_ema
+                  / (1 + state.steps.astype(jnp.float32) * 1e-5))
+            state, metrics = update(state, batch, lr)
+            return (state, key), metrics
+
+        (state, key), stacked = jax.lax.scan(
+            body, (state, key), None, length=num_steps)
+        summed = jax.tree_util.tree_map(lambda m: jnp.sum(m, axis=0), stacked)
+        return state, key, summed
+
+    if mesh is None:
+        return jax.jit(fused, donate_argnums=(0, 2))
+    repl = replicated_sharding(mesh)
+    return jax.jit(
+        fused,
+        in_shardings=(repl, repl, repl, repl, repl, repl),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 2),
     )

@@ -181,16 +181,26 @@ class Trainer:
         self.ingest_queue: Optional[queue.Queue] = None
         if args.get('device_replay'):
             from .ops.replay import DeviceReplay
+            from .ops.train_step import build_replay_update
             # ring capacity budget per episode: how many training windows a
             # typical episode contributes; override via config
             # 'replay_windows_per_episode' (default assumes ~64-step episodes)
             windows_per_ep = (args.get('replay_windows_per_episode')
                               or max(1, 64 // args['forward_steps']))
             self.replay = DeviceReplay(
-                capacity=min(args['maximum_episodes'], 4096) * windows_per_ep)
+                capacity=min(args['maximum_episodes'], 4096) * windows_per_ep,
+                mesh=self.mesh)
             self.ingest_queue = queue.Queue(maxsize=1024)
             self._pending_rows: List[Dict[str, Any]] = []
             self._sample_key = jax.random.PRNGKey(args.get('seed', 0) + 1)
+            # K SGD steps per program dispatch: sampling, LR schedule and
+            # update all stay on device inside one lax.scan, so replay-mode
+            # throughput is bounded by compute, not dispatch latency
+            self.fused_steps = max(1, int(args.get('replay_fused_steps') or 8))
+            self.replay_update = build_replay_update(
+                wrapper.module, self.cfg, capacity=self.replay.capacity,
+                batch_size=args['batch_size'], num_steps=self.fused_steps,
+                default_lr=self.default_lr, mesh=self.mesh)
             # observability: audited by metrics JSONL (replay_* fields)
             self.replay_stats = {'dropped_episodes': 0,
                                  'windows_ingested': 0,
@@ -260,17 +270,6 @@ class Trainer:
         staged = None   # one-slot H2D prefetch: upload batch t+1 while t runs
 
         def stage_next():
-            if self.replay is not None:
-                self._ingest_new_episodes()
-                if self.replay.size == 0:
-                    time.sleep(0.1)
-                    return None
-                self._sample_key, key = jax.random.split(self._sample_key)
-                sampled = self.replay.sample(key, self.args['batch_size'])
-                self.replay_stats['samples_drawn'] += self.args['batch_size']
-                if self.mesh is not None:
-                    sampled = shard_batch(self.mesh, sampled)
-                return sampled
             try:
                 nxt = self.batcher.batch(timeout=1.0)
             except queue.Empty:
@@ -280,6 +279,34 @@ class Trainer:
             return jax.tree_util.tree_map(jnp.asarray, nxt)
 
         while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
+            if self.replay is not None:
+                # fused path: one dispatch = fused_steps SGD steps, with
+                # batch sampling, LR schedule and PRNG advance all on device
+                self._ingest_new_episodes()
+                if self.replay.size == 0:
+                    time.sleep(0.1)
+                    continue
+                self.state, self._sample_key, metrics = self.replay_update(
+                    self.state, self.replay.buffers, self._sample_key,
+                    jnp.asarray(self.replay.size, jnp.int32),
+                    jnp.asarray(self.replay.cursor, jnp.int32),
+                    jnp.asarray(self.data_cnt_ema, jnp.float32))
+                self.replay_stats['samples_drawn'] += (
+                    self.args['batch_size'] * self.fused_steps)
+                pending_metrics.append(metrics)
+                batch_cnt += self.fused_steps
+                self.steps += self.fused_steps
+                if len(pending_metrics) >= 4:
+                    data_cnt += int(sum(float(m['data_count'])
+                                        for m in pending_metrics))
+                    self._drain_metrics(pending_metrics)
+                    pending_metrics = []
+                if 0 <= profile_stop_at <= self.steps:
+                    jax.block_until_ready(metrics['total'])
+                    jax.profiler.stop_trace()
+                    profile_stop_at = -1
+                    print('profiler trace written to %s' % self._profile_dir)
+                continue
             batch = staged if staged is not None else stage_next()
             staged = None
             if batch is None:

@@ -5,7 +5,7 @@ throughput (episodes/sec, SGD steps/sec) and the aggregate win rate vs
 random over the last 5 epochs, appending JSON rows to benchmarks.jsonl.
 
 Usage: python scripts/run_benchmark_matrix.py [ROW ...] [--epochs N]
-Rows: ttt-td ttt-vtrace geister geese
+Rows: ttt-td ttt-device ttt-vtrace geister geese geese-device
 """
 
 import json
@@ -51,6 +51,18 @@ ROWS = {
                        'turn_based_training': False, 'observation': True,
                        'gamma': 0.99,
                        'policy_target': 'VTRACE', 'value_target': 'VTRACE'},
+    },
+    # VERDICT r1 #5: the fully device-resident Hungry Geese pipeline —
+    # rollouts, replay ring, and SGD all on the accelerator
+    'geese-device': {
+        'env_args': {'env': 'HungryGeese'},
+        'train_args': {'batch_size': 64, 'forward_steps': 16,
+                       'update_episodes': 100, 'minimum_episodes': 200,
+                       'generation_envs': 64,
+                       'turn_based_training': False, 'observation': True,
+                       'gamma': 0.99,
+                       'policy_target': 'VTRACE', 'value_target': 'VTRACE',
+                       'device_generation': True, 'device_replay': True},
     },
 }
 
