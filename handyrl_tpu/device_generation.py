@@ -44,57 +44,106 @@ def _blank(players):
              'value', 'reward', 'return')}
 
 
+def _ply_inference(env_mod, apply_fn, recurrent, simultaneous,
+                   params, state, hidden):
+    """Shared per-ply plumbing for the device rollout engines (generation
+    and evaluation): observe, run the net — with the recurrent hidden
+    gather/scatter for turn-based envs and the (N, P)->(N*P) fold for
+    simultaneous ones — and build the illegal-action mask.
+
+    Returns (obs, logits, amask, hidden, out): logits/amask are (N, P, A)
+    for simultaneous envs, (N, A) turn-based; ``out`` is the raw model
+    output dict with 'hidden' already popped.
+    """
+    obs = env_mod.observe(state)
+    legal = env_mod.legal_mask(state)
+    amask = (1.0 - legal) * 1e32
+    if simultaneous:
+        N, P = obs.shape[:2]
+        flat = obs.reshape((N * P,) + obs.shape[2:])
+        if recurrent:
+            # every player's hidden advances each ply (they all observe);
+            # fold (N, P) into the batch dim
+            h_in = jax.tree_util.tree_map(
+                lambda h: h.reshape((N * P,) + h.shape[2:]), hidden)
+            out = dict(apply_fn(params, flat, h_in))
+            nh = out.pop('hidden')
+            hidden = jax.tree_util.tree_map(
+                lambda h: h.reshape((N, P) + h.shape[1:]), nh)
+        else:
+            out = dict(apply_fn(params, flat, None))
+        logits = out['policy'].reshape(N, P, -1) - amask
+    else:
+        if recurrent:
+            # gather the acting player's hidden slot, run the net, scatter
+            # the new state back (mirrors the omask-gated training carry)
+            rows = jnp.arange(obs_leading(obs))
+            player = env_mod.turn(state)
+            h_in = jax.tree_util.tree_map(
+                lambda h: h[rows, player], hidden)
+            out = dict(apply_fn(params, obs, h_in))
+            nh = out.pop('hidden')
+            hidden = jax.tree_util.tree_map(
+                lambda h, x: h.at[rows, player].set(x), hidden, nh)
+        else:
+            out = dict(apply_fn(params, obs, None))
+        logits = out['policy'] - amask
+    return obs, logits, amask, hidden, out
+
+
+def _reset_hidden_where_done(hidden, done):
+    """Fresh episodes start with zero recurrent state."""
+    return jax.tree_util.tree_map(
+        lambda h: jnp.where(done.reshape((-1,) + (1,) * (h.ndim - 1)),
+                            jnp.zeros_like(h), h), hidden)
+
+
+def _init_rollout_engine(engine, env_mod, wrapper, n_envs: int, seed: int):
+    """Shared env/model bootstrapping for the device rollout engines: env
+    state vector, PRNG key, simultaneous/recurrent detection, and the
+    per-env recurrent hidden pytree."""
+    engine.env_mod = env_mod
+    engine.wrapper = wrapper
+    engine.n_envs = n_envs
+    engine.simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+    try:
+        engine.state = env_mod.init_state(n_envs, seed)
+    except TypeError:
+        engine.state = env_mod.init_state(n_envs)
+    engine.rng = jax.random.PRNGKey(seed)
+    engine.recurrent = hasattr(wrapper.module, 'init_hidden')
+    engine.hidden = (wrapper.module.init_hidden(
+        (n_envs, env_mod.NUM_PLAYERS)) if engine.recurrent else None)
+
+
 class DeviceGenerator:
     """Runs chunks of device-resident self-play for a pure-JAX env module."""
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
                  n_envs: int = 256, chunk_steps: int = 16, seed: int = 0):
-        self.env_mod = env_mod
-        self.wrapper = wrapper
         self.args = args
-        self.n_envs = n_envs
         self.chunk_steps = chunk_steps
-        self.simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
-        try:
-            self.state = env_mod.init_state(n_envs, seed)
-        except TypeError:
-            self.state = env_mod.init_state(n_envs)
-        self.rng = jax.random.PRNGKey(seed)
+        _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         self._partials: List[List[dict]] = [[] for _ in range(n_envs)]
 
         apply_fn = wrapper.module.apply
         simultaneous = self.simultaneous
-        recurrent = hasattr(wrapper.module, 'init_hidden')
-        self.hidden = (wrapper.module.init_hidden(
-            (n_envs, env_mod.NUM_PLAYERS)) if recurrent else None)
+        recurrent = self.recurrent
 
         @jax.jit
         def rollout(params, state, hidden, rng):
             def body(carry, _):
                 state, hidden, rng = carry
-                obs = env_mod.observe(state)
+                obs, logits, amask, hidden, out = _ply_inference(
+                    env_mod, apply_fn, recurrent, simultaneous,
+                    params, state, hidden)
+                rng, key = jax.random.split(rng)
+                actions = jax.random.categorical(key, logits)
+                probs = jax.nn.softmax(logits, axis=-1)
+                sel = jnp.take_along_axis(probs, actions[..., None],
+                                          axis=-1)[..., 0]
                 if simultaneous:
                     N, P = obs.shape[:2]
-                    flat = obs.reshape((N * P,) + obs.shape[2:])
-                    if recurrent:
-                        # every player's hidden advances each ply (they all
-                        # observe); fold (N, P) into the batch dim
-                        h_in = jax.tree_util.tree_map(
-                            lambda h: h.reshape((N * P,) + h.shape[2:]), hidden)
-                        out = dict(apply_fn(params, flat, h_in))
-                        nh = out.pop('hidden')
-                        hidden = jax.tree_util.tree_map(
-                            lambda h: h.reshape((N, P) + h.shape[1:]), nh)
-                    else:
-                        out = apply_fn(params, flat, None)
-                    legal = env_mod.legal_mask(state)          # (N, P, A)
-                    amask = (1.0 - legal) * 1e32
-                    logits = out['policy'].reshape(N, P, -1) - amask
-                    rng, key = jax.random.split(rng)
-                    actions = jax.random.categorical(key, logits)
-                    probs = jax.nn.softmax(logits, axis=-1)
-                    sel = jnp.take_along_axis(probs, actions[..., None],
-                                              axis=-1)[..., 0]
                     value = out.get('value')
                     if value is not None:
                         value = value.reshape(N, P, -1)
@@ -107,27 +156,6 @@ class DeviceGenerator:
                               'outcome': env_mod.outcome(nstate)}
                 else:
                     player = env_mod.turn(state)
-                    if recurrent:
-                        # gather the acting player's hidden slot, run the
-                        # net, scatter the new state back (mirrors the
-                        # omask-gated carry the training scan uses)
-                        rows = jnp.arange(obs_leading(obs))
-                        h_in = jax.tree_util.tree_map(
-                            lambda h: h[rows, player], hidden)
-                        out = dict(apply_fn(params, obs, h_in))
-                        nh = out.pop('hidden')
-                        hidden = jax.tree_util.tree_map(
-                            lambda h, x: h.at[rows, player].set(x), hidden, nh)
-                    else:
-                        out = apply_fn(params, obs, None)
-                    legal = env_mod.legal_mask(state)          # (N, A)
-                    amask = (1.0 - legal) * 1e32
-                    logits = out['policy'] - amask
-                    rng, key = jax.random.split(rng)
-                    actions = jax.random.categorical(key, logits)
-                    probs = jax.nn.softmax(logits, axis=-1)
-                    sel = jnp.take_along_axis(probs, actions[:, None],
-                                              axis=-1)[:, 0]
                     nstate = env_mod.step(state, actions)
                     done = env_mod.terminal(nstate)
                     record = {'obs': obs, 'action': actions, 'prob': sel,
@@ -138,11 +166,7 @@ class DeviceGenerator:
                     record['reward'] = env_mod.rewards(nstate)   # (N, P)
                 nstate = env_mod.auto_reset(nstate, done)
                 if recurrent:
-                    # fresh episodes start with zero recurrent state
-                    hidden = jax.tree_util.tree_map(
-                        lambda h: jnp.where(
-                            done.reshape((-1,) + (1,) * (h.ndim - 1)),
-                            jnp.zeros_like(h), h), hidden)
+                    hidden = _reset_hidden_where_done(hidden, done)
                 return (nstate, hidden, rng), record
 
             (state, hidden, rng), records = jax.lax.scan(
@@ -224,3 +248,88 @@ class DeviceGenerator:
             'outcome': outcome,
             'moment': compress_moments(moments, self.args['compress_steps']),
         }
+
+
+class DeviceEvaluator:
+    """Device-resident online evaluation vs the random opponent.
+
+    The host BatchedEvaluator pays one inference dispatch per ply of every
+    match; on a dispatch-latency-heavy backend that makes evaluation the
+    dominant cost of the epoch loop (it needs ~10x more dispatches than
+    chunked device generation for the same ply count). When the opponent is
+    'random' (the reference's default, config.yaml eval.opponent) and the
+    env has a pure-JAX twin, the whole match runs on device instead: one
+    rotating seat per env plays the trained model greedily (the same
+    temperature-0 policy as BatchedEvaluator / reference agent.py Agent),
+    every other seat samples uniformly from its legal actions, and the host
+    receives only (done, outcome, seat) per ply — K plies of N matches per
+    program dispatch.
+    """
+
+    def __init__(self, env_mod, wrapper, args: Dict[str, Any],
+                 n_envs: int = 64, chunk_steps: int = 16, seed: int = 77):
+        self.args = args
+        self.chunk_steps = chunk_steps
+        _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
+        # one evaluated seat per env, rotated on every reset so first/second
+        # (and every goose slot) are balanced like evaluate_mp's scheduler
+        self.seat = jnp.arange(n_envs, dtype=jnp.int32) % env_mod.NUM_PLAYERS
+
+        apply_fn = wrapper.module.apply
+        simultaneous = self.simultaneous
+        recurrent = self.recurrent
+
+        @jax.jit
+        def rollout(params, state, hidden, seat, rng):
+            def body(carry, _):
+                state, hidden, seat, rng = carry
+                obs, logits, amask, hidden, _ = _ply_inference(
+                    env_mod, apply_fn, recurrent, simultaneous,
+                    params, state, hidden)
+                greedy = jnp.argmax(logits, axis=-1)
+                rng, key = jax.random.split(rng)
+                uniform = jax.random.categorical(key, -amask)
+                if simultaneous:
+                    P2 = logits.shape[1]
+                    is_main = (jnp.arange(P2)[None, :] == seat[:, None])
+                else:
+                    is_main = env_mod.turn(state) == seat
+                actions = jnp.where(is_main, greedy, uniform)
+                nstate = env_mod.step(state, actions)
+                done = env_mod.terminal(nstate)
+                record = {'done': done, 'seat': seat,
+                          'outcome': env_mod.outcome(nstate)}
+                nstate = env_mod.auto_reset(nstate, done)
+                seat = jnp.where(done,
+                                 (seat + 1) % env_mod.NUM_PLAYERS, seat)
+                if recurrent:
+                    hidden = _reset_hidden_where_done(hidden, done)
+                return (nstate, hidden, seat, rng), record
+
+            (state, hidden, seat, rng), records = jax.lax.scan(
+                body, (state, hidden, seat, rng), None, length=chunk_steps)
+            return state, hidden, seat, rng, records
+
+        self._rollout = rollout
+
+    def step(self) -> List[dict]:
+        """One compiled chunk; returns finished eval result records (the
+        same shape Learner.feed_results consumes from BatchedEvaluator)."""
+        self.state, self.hidden, self.seat, self.rng, records = \
+            self._rollout(self.wrapper.params, self.state, self.hidden,
+                          self.seat, self.rng)
+        done = np.asarray(records['done'])
+        seats = np.asarray(records['seat'])
+        outcomes = np.asarray(records['outcome'])
+        players = list(range(self.env_mod.NUM_PLAYERS))
+        results: List[dict] = []
+        for k, i in zip(*np.nonzero(done)):
+            seat = int(seats[k, i])
+            results.append({
+                'args': {'role': 'e', 'player': [seat],
+                         'model_id': {p: (0 if p == seat else -1)
+                                      for p in players}},
+                'opponent': 'random',
+                'result': {p: float(outcomes[k, i, p]) for p in players},
+            })
+        return results

@@ -650,13 +650,16 @@ class Learner:
             return e
 
         gen = None
+        env_mod = None
+        chunk_steps = int(args.get('device_chunk_steps') or 16)
         if args.get('device_generation'):
             from .environment import make_jax_env
             from .device_generation import DeviceGenerator
             env_mod = make_jax_env(env_args)
             if env_mod is not None:
                 gen = DeviceGenerator(env_mod, actor, args,
-                                      n_envs=args.get('generation_envs', 64))
+                                      n_envs=args.get('generation_envs', 64),
+                                      chunk_steps=chunk_steps)
                 gen.step = gen.step_chunk   # same streaming surface
             else:
                 print('no pure-JAX twin for %s; falling back to host envs'
@@ -664,9 +667,20 @@ class Learner:
         if gen is None:
             gen = BatchedGenerator(make_env_fn, actor, args,
                                    n_envs=args.get('generation_envs', 64))
-        evaluator = BatchedEvaluator(
-            make_env_fn, actor, args,
-            n_envs=max(4, args.get('generation_envs', 64) // 8))
+        eval_envs = int(args.get('eval_envs')
+                        or max(4, args.get('generation_envs', 64) // 8))
+        opponents = args.get('eval', {}).get('opponent', []) or ['random']
+        if (env_mod is not None and set(opponents) == {'random'}
+                and args.get('device_eval', True)):
+            # eval matches ride the accelerator too: the host evaluator's
+            # one-dispatch-per-ply cost dominates chunked device generation
+            from .device_generation import DeviceEvaluator
+            evaluator = DeviceEvaluator(env_mod, actor, args,
+                                        n_envs=eval_envs,
+                                        chunk_steps=chunk_steps)
+        else:
+            evaluator = BatchedEvaluator(make_env_fn, actor, args,
+                                         n_envs=eval_envs)
 
         prev_update_episodes = args['minimum_episodes']
         next_update_episodes = prev_update_episodes + args['update_episodes']
@@ -678,10 +692,12 @@ class Learner:
                 self.num_episodes += 1
             self.feed_episodes(episodes)
 
-            # keep the evaluation share near eval_rate: the vectorized
-            # evaluator advances all its matches one ply per call; chunked
-            # generators deliver episodes in bursts, so give eval several
-            # plies per loop iteration or it never finishes a match
+            # keep the evaluation share near eval_rate. The host evaluator
+            # advances all its matches ONE ply per call while chunked
+            # generators deliver episodes in bursts, so it gets several
+            # plies per loop iteration or it never finishes a match; the
+            # device evaluator finishes whole batches per call and exits
+            # this loop after one step once the share is met
             for _ in range(16):
                 if self.num_results >= self.eval_rate * self.num_episodes:
                     break
