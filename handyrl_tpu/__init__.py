@@ -8,3 +8,24 @@ orchestration.
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+# Persistent XLA compilation cache: the recurrent update steps (DRC nets)
+# take minutes of LLVM codegen on the CPU backend and tens of seconds on
+# TPU; caching makes every compile a one-time cost across processes and
+# runs. Opt out with HANDYRL_TPU_NO_COMPILE_CACHE=1.
+if not _os.environ.get('HANDYRL_TPU_NO_COMPILE_CACHE'):
+    _cache_dir = _os.environ.get(
+        'JAX_COMPILATION_CACHE_DIR',
+        _os.path.join(_os.path.expanduser('~'), '.cache', 'handyrl_tpu_xla'))
+    _os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', _cache_dir)
+    try:
+        import jax as _jax
+
+        _jax.config.update('jax_compilation_cache_dir', _cache_dir)
+        # cache across backends including CPU, and even quick compiles —
+        # the test suite and bench re-trace the same programs constantly
+        _jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass

@@ -41,6 +41,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'eval_envs': None,            # concurrent online-eval matches; None = max(4, generation_envs // 8)
     'device_chunk_steps': 16,     # plies per device-generation program dispatch
     'device_eval': True,          # device-resident eval matches when device_generation is on and the opponent is 'random'
+    'device_ingest': True,        # assemble training windows on device (device_generation + device_replay, single-device)
     'device_generation': False,   # fully device-resident rollouts (envs with a pure-JAX twin)
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
     'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)

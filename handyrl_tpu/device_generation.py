@@ -175,6 +175,21 @@ class DeviceGenerator:
 
         self._rollout = rollout
 
+    def step_chunk_records(self):
+        """Run one compiled chunk, keeping the trajectory ON DEVICE.
+
+        For the device-ingest pipeline (ops/device_windows.py): returns the
+        raw records pytree (device arrays, leading axes (K, N)) plus host
+        copies of ONLY the tiny done/outcome arrays for episode accounting.
+        The heavy leaves (observations, masks) never reach the host.
+        """
+        self.state, self.hidden, self.rng, records = self._rollout(
+            self.wrapper.params, self.state, self.hidden, self.rng)
+        records = dict(records)
+        done = np.asarray(records['done'])
+        outcome = np.asarray(records['outcome'])
+        return records, done, outcome
+
     # -- host-side episode splicing ---------------------------------------
     def step_chunk(self) -> List[dict]:
         """Run one compiled chunk; return episodes completed within it."""

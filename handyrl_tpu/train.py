@@ -205,6 +205,20 @@ class Trainer:
             self.replay_stats = {'dropped_episodes': 0,
                                  'windows_ingested': 0,
                                  'samples_drawn': 0}
+            # device-ingest mode (ops/device_windows.py): the learner
+            # installs a DeviceWindower when the env/config supports it;
+            # rollout chunks then arrive as device arrays on chunk_queue
+            # and windows are assembled straight into the ring in HBM
+            self.windower = None
+            self.chunk_queue: queue.Queue = queue.Queue(maxsize=4)
+            self.seen_episodes = 0     # learner-fed count (no host deque)
+            self._ring = None
+            self._ring_state = None
+            self._ring_cursor = None
+            self._ring_size = None
+            self._ring_ready = False
+            self._ingest_key = jax.random.PRNGKey(args.get('seed', 0) + 2)
+            self._pending_ingest: List[Any] = []
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
@@ -282,14 +296,23 @@ class Trainer:
             if self.replay is not None:
                 # fused path: one dispatch = fused_steps SGD steps, with
                 # batch sampling, LR schedule and PRNG advance all on device
-                self._ingest_new_episodes()
-                if self.replay.size == 0:
-                    time.sleep(0.1)
-                    continue
+                if self.windower is not None:
+                    self._ingest_device_chunks()
+                    if not self._ring_ready:
+                        time.sleep(0.1)
+                        continue
+                    buffers = self._ring
+                    size, cursor = self._ring_size, self._ring_cursor
+                else:
+                    self._ingest_new_episodes()
+                    if self.replay.size == 0:
+                        time.sleep(0.1)
+                        continue
+                    buffers = self.replay.buffers
+                    size = jnp.asarray(self.replay.size, jnp.int32)
+                    cursor = jnp.asarray(self.replay.cursor, jnp.int32)
                 self.state, self._sample_key, metrics = self.replay_update(
-                    self.state, self.replay.buffers, self._sample_key,
-                    jnp.asarray(self.replay.size, jnp.int32),
-                    jnp.asarray(self.replay.cursor, jnp.int32),
+                    self.state, buffers, self._sample_key, size, cursor,
                     jnp.asarray(self.data_cnt_ema, jnp.float32))
                 self.replay_stats['samples_drawn'] += (
                     self.args['batch_size'] * self.fused_steps)
@@ -344,6 +367,52 @@ class Trainer:
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
+    def _ingest_device_chunks(self):
+        """Drain rollout-record chunks (device arrays) into the HBM ring via
+        the windower's compiled ingest program. First chunk allocates the
+        history and ring buffers from the observed record shapes. This
+        thread is the single owner of ring/history state, so the program
+        donates them in place."""
+        ingested = 0
+        while ingested < 8:
+            try:
+                records = self.chunk_queue.get_nowait()
+            except queue.Empty:
+                break
+            ingested += 1
+            if self._ring is None:
+                self._ring_state = self.windower.init_state(records)
+                self._ring = self.windower.init_ring(records)
+                self._ring_cursor = jnp.zeros((), jnp.int32)
+                self._ring_size = jnp.zeros((), jnp.int32)
+            (self._ring_state, self._ring, self._ring_cursor,
+             self._ring_size, self._ingest_key, _n_done, n_win) = \
+                self.windower.ingest(records, self._ring_state, self._ring,
+                                     self._ring_cursor, self._ring_size,
+                                     self._ingest_key)
+            self._pending_ingest.append(n_win)
+        # fetch window counts lazily; the startup gate needs a real sync
+        if self._pending_ingest and (not self._ring_ready
+                                     or len(self._pending_ingest) >= 8):
+            total = int(sum(int(x) for x in self._pending_ingest))
+            self._pending_ingest = []
+            self.replay_stats['windows_ingested'] += total
+            # host mirror of the device ring size: other threads (metrics)
+            # must never touch _ring_size itself — it is donated in flight
+            self._ring_size_host = min(
+                getattr(self, '_ring_size_host', 0) + total,
+                self.replay.capacity)
+            if total > 0:
+                self._ring_ready = True
+
+    def ring_occupancy(self) -> float:
+        if self.replay is None:
+            return 0.0
+        if getattr(self, 'windower', None) is not None:
+            return (getattr(self, '_ring_size_host', 0)
+                    / self.replay.capacity)
+        return self.replay.size / self.replay.capacity
+
     PUSH_CHUNK = 8   # fixed ring-push size => one XLA scatter compile
 
     def _ingest_new_episodes(self):
@@ -386,8 +455,15 @@ class Trainer:
     def run(self):
         print('waiting training')
         while (len(self.episodes) < self.args['minimum_episodes']
+               and getattr(self, 'seen_episodes', 0)
+               < self.args['minimum_episodes']
                and not self.shutdown_flag):
-            time.sleep(1)
+            if getattr(self, 'windower', None) is not None:
+                # keep consuming rollout chunks while waiting: generation
+                # blocks on the chunk queue (stream contiguity), so the ring
+                # must fill during warmup too
+                self._ingest_device_chunks()
+            time.sleep(0.1)
         if self.state is not None and not self.shutdown_flag:
             if self.replay is None:
                 self.batcher.run()
@@ -554,6 +630,26 @@ class Learner:
         while len(self.trainer.episodes) > maximum_episodes:
             self.trainer.episodes.popleft()
 
+    def feed_device_chunk(self, done, outcome) -> int:
+        """Episode accounting for device-ingested rollout chunks: only the
+        (done, outcome) arrays reach the host — trajectories stay in HBM
+        (ops/device_windows.py). Mirrors feed_episodes' generation stats
+        (every player's outcome counts, feed over args['player'])."""
+        ks, envs = np.nonzero(done)
+        num_players = outcome.shape[-1]
+        for k, i in zip(ks, envs):
+            model_id = self.model_epoch
+            for p in range(num_players):
+                oc = float(outcome[k, i, p])
+                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
+                self.generation_results[model_id] = (n + 1, r + oc,
+                                                     r2 + oc ** 2)
+            self.num_episodes += 1
+            self.num_returned_episodes += 1
+            if self.num_returned_episodes % 100 == 0:
+                print(self.num_returned_episodes, end=' ', flush=True)
+        return len(ks)
+
     def feed_results(self, results: List[Optional[dict]]):
         for result in results:
             if result is None:
@@ -630,7 +726,7 @@ class Learner:
             stats = self.trainer.replay_stats
             rec['replay_dropped_episodes'] = stats['dropped_episodes']
             rec['replay_ring_occupancy'] = round(
-                self.trainer.replay.size / self.trainer.replay.capacity, 4)
+                self.trainer.ring_occupancy(), 4)
             rec['replay_sample_reuse'] = round(
                 stats['samples_drawn'] / max(1, stats['windows_ingested']), 3)
         with open(self._metrics_path, 'a') as f:
@@ -682,15 +778,62 @@ class Learner:
             evaluator = BatchedEvaluator(make_env_fn, actor, args,
                                          n_envs=eval_envs)
 
+        # device ingest: trajectories never leave the accelerator — rollout
+        # records flow straight into the windower's HBM ring; the host does
+        # episode accounting from the (done, outcome) arrays only
+        device_ingest = False
+        if (env_mod is not None and args.get('device_replay')
+                and args.get('device_ingest', True)
+                and self.trainer.mesh is None):
+            simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+            mode = None
+            if simultaneous and not args['turn_based_training']:
+                mode = 'solo'
+            elif (not simultaneous and args['turn_based_training']
+                  and not args['observation']):
+                mode = 'turn'
+            if mode is not None:
+                from .ops.device_windows import DeviceWindower
+                max_steps = int(getattr(env_mod, 'MAX_STEPS',
+                                        getattr(env_mod, 'MAX_PLIES', 256)))
+                windows_cap = (args.get('replay_windows_per_episode')
+                               or max(1, 64 // args['forward_steps']))
+                self.trainer.windower = DeviceWindower(
+                    mode=mode, fs=args['forward_steps'],
+                    bi=args['burn_in_steps'], max_steps=max_steps,
+                    windows_cap=windows_cap,
+                    capacity=self.trainer.replay.capacity,
+                    num_players=env_mod.NUM_PLAYERS, gamma=args['gamma'],
+                    has_reward=hasattr(env_mod, 'rewards'))
+                device_ingest = True
+                print('device ingest: windows assembled on device '
+                      '(%s mode)' % mode)
+
         prev_update_episodes = args['minimum_episodes']
         next_update_episodes = prev_update_episodes + args['update_episodes']
 
         while not self.shutdown_flag:
             actor.params = self.wrapper.params   # follow latest epoch
-            episodes = gen.step()
-            for ep in episodes:
-                self.num_episodes += 1
-            self.feed_episodes(episodes)
+            if device_ingest:
+                records, done, outcome = gen.step_chunk_records()
+                self.feed_device_chunk(done, outcome)
+                self.trainer.seen_episodes = self.num_returned_episodes
+                # BLOCKING hand-off: the windower's per-env histories track
+                # a contiguous ply stream, so dropping a chunk would splice
+                # different episodes together — backpressure generation
+                # instead (the trainer drains chunks even while it waits
+                # for minimum_episodes)
+                while not self.shutdown_flag:
+                    try:
+                        self.trainer.chunk_queue.put(records, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+            else:
+                episodes = gen.step()
+                for ep in episodes:
+                    self.num_episodes += 1
+                self.feed_episodes(episodes)
 
             # keep the evaluation share near eval_rate. The host evaluator
             # advances all its matches ONE ply per call while chunked

@@ -43,6 +43,20 @@ ROWS = {
                        'minimum_episodes': 200, 'generation_envs': 32,
                        'observation': True},
     },
+    # Geister through the device pipeline (DRC recurrent device rollouts).
+    # The plain 'geister' row is unusable on the XLA-CPU backend: LLVM
+    # codegen of the full DRC update step takes tens of minutes there
+    # (first run only, with the persistent compile cache) — the TPU backend
+    # is the real target for this net.
+    'geister-device': {
+        'env_args': {'env': 'Geister'},
+        'train_args': {'batch_size': 32, 'forward_steps': 16,
+                       'burn_in_steps': 4, 'update_episodes': 100,
+                       'minimum_episodes': 200, 'generation_envs': 32,
+                       'observation': True,
+                       'device_generation': True, 'device_replay': True,
+                       'device_chunk_steps': 32, 'eval_envs': 32},
+    },
     'geese': {
         'env_args': {'env': 'HungryGeese'},
         'train_args': {'batch_size': 64, 'forward_steps': 16,
@@ -62,7 +76,8 @@ ROWS = {
                        'turn_based_training': False, 'observation': True,
                        'gamma': 0.99,
                        'policy_target': 'VTRACE', 'value_target': 'VTRACE',
-                       'device_generation': True, 'device_replay': True},
+                       'device_generation': True, 'device_replay': True,
+                       'device_chunk_steps': 32, 'eval_envs': 32},
     },
 }
 

@@ -1,0 +1,237 @@
+"""On-device window assembly vs the host batch builder: exact parity.
+
+Feeds the SAME synthetic episode through ops/batch.py build_window (host
+reference path, itself pinned to reference train.py:33-124 semantics) and
+ops/device_windows.py build_windows_{turn,solo}, for every train_start,
+including the burn-in-pad and episode-tail-pad regimes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from handyrl_tpu.ops.batch import build_window
+from handyrl_tpu.ops.device_windows import (DeviceWindower,
+                                            build_windows_solo,
+                                            build_windows_turn,
+                                            _discounted_returns)
+
+FS, BI = 4, 2
+L = 16
+GAMMA = 0.8
+
+
+def _turn_episode(S=10, A=5, P=2, seed=0):
+    rng = np.random.RandomState(seed)
+    obs = rng.rand(S, 3, 3, 3).astype(np.float32)
+    prob = rng.uniform(0.1, 1.0, S).astype(np.float32)
+    action = rng.randint(0, A, S).astype(np.int32)
+    amask = np.where(rng.rand(S, A) < 0.3, 1e32, 0).astype(np.float32)
+    value = rng.uniform(-1, 1, (S, 1)).astype(np.float32)
+    player = (np.arange(S) % P).astype(np.int32)
+    reward = rng.uniform(-0.1, 0.1, (S, P)).astype(np.float32)
+    outcome = np.array([1.0, -1.0], np.float32)
+    return dict(obs=obs, prob=prob, action=action, amask=amask, value=value,
+                player=player, reward=reward, outcome=outcome, S=S, P=P)
+
+
+def _host_moments(ep):
+    """The episode in generator moment format (generation.py records)."""
+    S, P = ep['S'], ep['P']
+    rets = np.zeros((S, P), np.float32)
+    acc = np.zeros(P, np.float32)
+    for t in range(S - 1, -1, -1):
+        acc = ep['reward'][t] + GAMMA * acc
+        rets[t] = acc
+    moments = []
+    for t in range(S):
+        p = int(ep['player'][t])
+        m = {key: {q: None for q in range(P)} for key in
+             ('observation', 'selected_prob', 'action_mask', 'action',
+              'value', 'reward', 'return')}
+        m['observation'][p] = ep['obs'][t]
+        m['selected_prob'][p] = float(ep['prob'][t])
+        m['action_mask'][p] = ep['amask'][t]
+        m['action'][p] = int(ep['action'][t])
+        m['value'][p] = ep['value'][t]
+        m['reward'] = {q: float(ep['reward'][t, q]) for q in range(P)}
+        m['return'] = {q: float(rets[t, q]) for q in range(P)}
+        m['turn'] = [p]
+        moments.append(m)
+    return moments, rets
+
+
+def _turn_hist(ep):
+    S = ep['S']
+    pad = lambda a: np.concatenate(
+        [a, np.zeros((L - S,) + a.shape[1:], a.dtype)])
+    valid = np.arange(L) < S
+    rew = pad(ep['reward'])
+    ret = np.asarray(_discounted_returns(jnp.asarray(rew),
+                                         jnp.asarray(valid), GAMMA))
+    return {'obs': jnp.asarray(pad(ep['obs'])),
+            'prob': jnp.asarray(pad(ep['prob'])),
+            'action': jnp.asarray(pad(ep['action'])),
+            'amask': jnp.asarray(pad(ep['amask'])),
+            'value': jnp.asarray(pad(ep['value'])),
+            'player': jnp.asarray(pad(ep['player'])),
+            'reward': jnp.asarray(rew),
+            'return': jnp.asarray(ret)}
+
+
+ARGS = {'turn_based_training': True, 'observation': False,
+        'forward_steps': FS, 'burn_in_steps': BI}
+
+
+def test_turn_mode_matches_host_builder_every_train_start():
+    ep = _turn_episode()
+    moments, _ = _host_moments(ep)
+    hist = _turn_hist(ep)
+    S = ep['S']
+    for ts in range(1 + max(0, S - FS)):
+        st = max(0, ts - BI)
+        ed = min(ts + FS, S)
+        meta = {'outcome': {0: 1.0, 1: -1.0}, 'start': st, 'end': ed,
+                'train_start': ts, 'total': S}
+        host = build_window(moments[st:ed], meta, ARGS)
+        dev = build_windows_turn(hist, jnp.int32(S),
+                                 jnp.asarray([ts], jnp.int32),
+                                 jnp.asarray(ep['outcome']), FS, BI, L,
+                                 ep['P'])
+        for key in host:
+            h = np.asarray(host[key], np.float32)
+            d = np.asarray(dev[key][0], np.float32)
+            np.testing.assert_allclose(
+                d, h, rtol=1e-5, atol=1e-6,
+                err_msg='turn mode key=%s train_start=%d' % (key, ts))
+
+
+def _solo_episode(S=9, A=4, P=3, seed=3):
+    rng = np.random.RandomState(seed)
+    acting = rng.rand(S, P) < 0.7
+    acting[:, 0] = True   # keep at least one actor per ply
+    return dict(
+        obs=rng.rand(S, P, 2, 3, 3).astype(np.float32),
+        prob=rng.uniform(0.1, 1.0, (S, P)).astype(np.float32),
+        action=rng.randint(0, A, (S, P)).astype(np.int32),
+        amask=np.where(rng.rand(S, P, A) < 0.3, 1e32, 0).astype(np.float32),
+        value=rng.uniform(-1, 1, (S, P, 1)).astype(np.float32),
+        acting=acting,
+        reward=rng.uniform(-0.1, 0.1, (S, P)).astype(np.float32),
+        outcome=np.array([1.0, -1 / 3, -2 / 3], np.float32), S=S, P=P)
+
+
+def _solo_moments(ep):
+    S, P = ep['S'], ep['P']
+    rets = np.zeros((S, P), np.float32)
+    acc = np.zeros(P, np.float32)
+    for t in range(S - 1, -1, -1):
+        acc = ep['reward'][t] + GAMMA * acc
+        rets[t] = acc
+    moments = []
+    for t in range(S):
+        m = {key: {q: None for q in range(P)} for key in
+             ('observation', 'selected_prob', 'action_mask', 'action',
+              'value', 'reward', 'return')}
+        actors = []
+        for p in range(P):
+            if not ep['acting'][t, p]:
+                continue
+            actors.append(p)
+            m['observation'][p] = ep['obs'][t, p]
+            m['selected_prob'][p] = float(ep['prob'][t, p])
+            m['action_mask'][p] = ep['amask'][t, p]
+            m['action'][p] = int(ep['action'][t, p])
+            m['value'][p] = ep['value'][t, p]
+        m['reward'] = {q: float(ep['reward'][t, q]) for q in range(P)}
+        m['return'] = {q: float(rets[t, q]) for q in range(P)}
+        m['turn'] = actors
+        moments.append(m)
+    return moments
+
+
+def _solo_hist(ep):
+    S = ep['S']
+    pad = lambda a: np.concatenate(
+        [a, np.zeros((L - S,) + a.shape[1:], a.dtype)])
+    valid = np.arange(L) < S
+    rew = pad(ep['reward'])
+    ret = np.asarray(_discounted_returns(jnp.asarray(rew),
+                                         jnp.asarray(valid), GAMMA))
+    return {'obs': jnp.asarray(pad(ep['obs'])),
+            'prob': jnp.asarray(pad(ep['prob'])),
+            'action': jnp.asarray(pad(ep['action'])),
+            'amask': jnp.asarray(pad(ep['amask'])),
+            'value': jnp.asarray(pad(ep['value'])),
+            'acting': jnp.asarray(pad(ep['acting'])),
+            'reward': jnp.asarray(rew),
+            'return': jnp.asarray(ret)}
+
+
+SOLO_ARGS = {'turn_based_training': False, 'observation': True,
+             'forward_steps': FS, 'burn_in_steps': BI}
+
+
+def test_solo_mode_matches_host_builder(monkeypatch):
+    ep = _solo_episode()
+    moments = _solo_moments(ep)
+    hist = _solo_hist(ep)
+    S, P = ep['S'], ep['P']
+    for seat in range(P):
+        # pin the host builder's random seat choice to `seat`
+        import random as _random
+        monkeypatch.setattr(_random, 'choice', lambda seq: seat)
+        for ts in range(1 + max(0, S - FS)):
+            st = max(0, ts - BI)
+            ed = min(ts + FS, S)
+            meta = {'outcome': {q: float(ep['outcome'][q]) for q in range(P)},
+                    'start': st, 'end': ed, 'train_start': ts, 'total': S}
+            host = build_window(moments[st:ed], meta, SOLO_ARGS)
+            dev = build_windows_solo(hist, jnp.int32(S),
+                                     jnp.asarray([ts], jnp.int32),
+                                     jnp.asarray([seat], jnp.int32),
+                                     jnp.asarray(ep['outcome']), FS, BI, L)
+            for key in host:
+                h = np.asarray(host[key], np.float32)
+                d = np.asarray(dev[key][0], np.float32)
+                np.testing.assert_allclose(
+                    d, h, rtol=1e-5, atol=1e-6,
+                    err_msg='solo key=%s seat=%d ts=%d' % (key, seat, ts))
+
+
+def test_ingest_fills_ring_and_counts_episodes():
+    """End-to-end chunk ingestion: two tiny turn-based envs, deterministic
+    done pattern, ring receives windows and episode counts add up."""
+    K, N, A, P, S = 6, 2, 3, 2, 3   # every env finishes every 3 plies
+    rng = np.random.RandomState(1)
+    records = {
+        'obs': jnp.asarray(rng.rand(K, N, 2, 2).astype(np.float32)),
+        'prob': jnp.asarray(rng.uniform(0.2, 1, (K, N)).astype(np.float32)),
+        'action': jnp.asarray(rng.randint(0, A, (K, N)).astype(np.int32)),
+        'amask': jnp.asarray(np.zeros((K, N, A), np.float32)),
+        'value': jnp.asarray(rng.rand(K, N, 1).astype(np.float32)),
+        'player': jnp.asarray((np.indices((K, N))[0] % P).astype(np.int32)),
+        'done': jnp.asarray((np.indices((K, N))[0] % S) == S - 1),
+        'outcome': jnp.asarray(
+            np.tile(np.array([1., -1.], np.float32), (K, N, 1))),
+    }
+    wd = DeviceWindower(mode='turn', fs=2, bi=0, max_steps=8, windows_cap=2,
+                        capacity=32, num_players=P, gamma=GAMMA,
+                        has_reward=False)
+    state = wd.init_state(records)
+    ring = wd.init_ring(records)
+    state, ring, cursor, size, key, n_done, n_windows = wd.ingest(
+        records, state, ring, jnp.int32(0), jnp.int32(0),
+        jax.random.PRNGKey(0))
+    # 2 envs x 2 episodes each completed in 6 plies
+    assert int(n_done) == 4
+    assert int(n_windows) == 4
+    assert int(size) == 4   # S//fs = 1 window per episode
+    assert int(cursor) == 4
+    got = jax.tree_util.tree_map(lambda b: np.asarray(b[:4]), ring)
+    assert got['observation'].shape == (4, 2, 1, 2, 2)
+    assert got['turn_mask'].shape == (4, 2, P, 1)
+    # every stored window is fully inside its episode (fs=2 <= S=3)
+    assert np.all(got['episode_mask'] == 1.0)
+    # counts reset after each done
+    assert np.all(np.asarray(state['counts']) == 0)
