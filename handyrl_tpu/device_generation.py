@@ -98,6 +98,44 @@ def _reset_hidden_where_done(hidden, done):
                             jnp.zeros_like(h), h), hidden)
 
 
+def _ply_inference_observe_all(env_mod, apply_fn, recurrent, num_players,
+                               params, state, hidden):
+    """Turn-based env, observation=True: EVERY player observes each ply
+    from its own perspective (env_mod.observe_as) and advances its own
+    recurrent state — the host generator's behavior (each observing seat
+    runs inference per ply, reference generation.py:23-46). Only the turn
+    player's policy row is used for the action.
+
+    Returns (obs (N,P,...), logits (N,A), amask (N,A), value (N,P,1),
+    hidden, player (N,)).
+    """
+    player = env_mod.turn(state)
+    N = player.shape[0]
+    P = num_players
+    views = [env_mod.observe_as(state, jnp.full((N,), p, jnp.int32))
+             for p in range(P)]
+    obs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=1), *views)
+    flat = jax.tree_util.tree_map(
+        lambda o: o.reshape((N * P,) + o.shape[2:]), obs)
+    if recurrent:
+        h_in = jax.tree_util.tree_map(
+            lambda h: h.reshape((N * P,) + h.shape[2:]), hidden)
+        out = dict(apply_fn(params, flat, h_in))
+        nh = out.pop('hidden')
+        hidden = jax.tree_util.tree_map(
+            lambda h: h.reshape((N, P) + h.shape[1:]), nh)
+    else:
+        out = dict(apply_fn(params, flat, None))
+    legal = env_mod.legal_mask(state)                 # (N, A), turn player
+    amask = (1.0 - legal) * 1e32
+    policy = out['policy'].reshape(N, P, -1)
+    logits = policy[jnp.arange(N), player] - amask
+    value = out.get('value')
+    if value is not None:
+        value = value.reshape(N, P, -1)
+    return obs, logits, amask, value, hidden, player
+
+
 def _init_rollout_engine(engine, env_mod, wrapper, n_envs: int, seed: int):
     """Shared env/model bootstrapping for the device rollout engines: env
     state vector, PRNG key, simultaneous/recurrent detection, and the

@@ -219,9 +219,17 @@ def step(state: State, actions: jnp.ndarray) -> State:
 def observe(state: State) -> jnp.ndarray:
     """Acting player's view as a dict-free stack: this device twin returns
     {'scalar': (N, 18), 'board': (N, 7, 6, 6)} to match GeisterNet's input."""
-    c = state.color.astype(jnp.int32)
+    return observe_as(state, state.color.astype(jnp.int32))
+
+
+def observe_as(state: State, viewer: jnp.ndarray) -> jnp.ndarray:
+    """View for an arbitrary (N,) viewer seat (host observation(player),
+    geister.py:302-340): board from the viewer's perspective, opponent
+    piece types hidden, turn flag set when the viewer is to move."""
+    c = viewer.astype(jnp.int32)
     opp = 1 - c
     piece = state.board.astype(jnp.int32)
+    turn_view = (state.color.astype(jnp.int32) == c)
 
     def cnt(code):
         return jnp.take_along_axis(state.counts, code[:, None], axis=1)[:, 0]
@@ -235,7 +243,7 @@ def observe(state: State) -> jnp.ndarray:
 
     scalar = jnp.concatenate([
         (c == 0).astype(jnp.float32)[:, None],
-        jnp.ones((piece.shape[0], 1), jnp.float32),     # turn view
+        turn_view.astype(jnp.float32)[:, None],
         onehot4(n_my_b), onehot4(n_my_r), onehot4(n_op_b), onehot4(n_op_r),
     ], axis=1)
 

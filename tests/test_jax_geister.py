@@ -60,6 +60,29 @@ def test_observation_matches_host():
         dev = jg.step(dev, jnp.asarray([action]))
 
 
+def test_observer_view_matches_host():
+    """observe_as must reproduce host observation(player) for BOTH seats —
+    including the observing (non-turn) player's rotated, turn-flag-0 view."""
+    rng = random.Random(5)
+    host = HostGeister()
+    dev = jg.init_state(1)
+    for _ in range(12):
+        if host.terminal():
+            break
+        for player in (0, 1):
+            obs_host = host.observation(player)
+            obs_dev = jax.tree_util.tree_map(
+                lambda v: np.asarray(v)[0],
+                jg.observe_as(dev, jnp.asarray([player])))
+            np.testing.assert_array_equal(obs_dev['scalar'],
+                                          obs_host['scalar'])
+            np.testing.assert_array_equal(obs_dev['board'],
+                                          obs_host['board'])
+        action = rng.choice(host.legal_actions())
+        host.play(action)
+        dev = jg.step(dev, jnp.asarray([action]))
+
+
 def test_recurrent_device_generation():
     """DRC hidden state carried through the on-device rollout; episodes feed
     the standard (burn-in) batch builder."""
