@@ -188,6 +188,8 @@ def headline_setup(B=128, T=16, dtype=None, seed=0):
     import jax
     import numpy as np
 
+    import handyrl_tpu
+    handyrl_tpu.setup_compile_cache()
     from handyrl_tpu.models import build
     from handyrl_tpu.ops.losses import LossConfig
     from handyrl_tpu.ops.train_step import init_train_state

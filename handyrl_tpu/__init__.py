@@ -9,23 +9,37 @@ orchestration.
 
 __version__ = "0.1.0"
 
-import os as _os
+_cache_ready = False
 
-# Persistent XLA compilation cache: the recurrent update steps (DRC nets)
-# take minutes of LLVM codegen on the CPU backend and tens of seconds on
-# TPU; caching makes every compile a one-time cost across processes and
-# runs. Opt out with HANDYRL_TPU_NO_COMPILE_CACHE=1.
-if not _os.environ.get('HANDYRL_TPU_NO_COMPILE_CACHE'):
-    _cache_dir = _os.environ.get(
-        'JAX_COMPILATION_CACHE_DIR',
-        _os.path.join(_os.path.expanduser('~'), '.cache', 'handyrl_tpu_xla'))
-    _os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', _cache_dir)
+
+def setup_compile_cache():
+    """Enable the persistent XLA compilation cache (explicit, idempotent).
+
+    The recurrent update steps (DRC nets) take minutes of LLVM codegen on
+    the CPU backend and tens of seconds on TPU; caching makes every compile
+    a one-time cost across processes and runs. Called from the framework's
+    own entry points (CLI, Learner, spawned workers, bench, tests) — NOT at
+    package import, so embedding applications keep full control of jax
+    config and ``import handyrl_tpu`` stays side-effect free. If the
+    operator already configured a cache dir (JAX_COMPILATION_CACHE_DIR or
+    jax.config), their setup is left untouched. Opt out entirely with
+    HANDYRL_TPU_NO_COMPILE_CACHE=1.
+    """
+    global _cache_ready
+    import os
+    if _cache_ready or os.environ.get('HANDYRL_TPU_NO_COMPILE_CACHE'):
+        return
+    _cache_ready = True
     try:
-        import jax as _jax
+        import jax
 
-        _jax.config.update('jax_compilation_cache_dir', _cache_dir)
+        if jax.config.jax_compilation_cache_dir:
+            return   # operator already chose a cache; leave it alone
+        cache_dir = os.path.join(os.path.expanduser('~'), '.cache',
+                                 'handyrl_tpu_xla')
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
         # cache across backends including CPU, and even quick compiles —
         # the test suite and bench re-trace the same programs constantly
-        _jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
     except Exception:  # pragma: no cover - cache is best-effort
         pass

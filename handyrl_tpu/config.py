@@ -89,8 +89,12 @@ def apply_defaults(raw: Dict[str, Any]) -> Dict[str, Any]:
 
 def validate(args: Dict[str, Any]) -> None:
     ta = args['train_args']
-    assert ta['policy_target'] in ('MC', 'TD', 'UPGO', 'VTRACE'), ta['policy_target']
-    assert ta['value_target'] in ('MC', 'TD', 'VTRACE', 'TD', 'UPGO'), ta['value_target']
+    # Both estimators dispatch through the same compute_target
+    # (ops/targets.py), exactly as the reference's losses.py:63 does for
+    # policy AND value — so all four algorithms are legal for either knob.
+    _TARGETS = ('MC', 'TD', 'VTRACE', 'UPGO')
+    assert ta['policy_target'] in _TARGETS, ta['policy_target']
+    assert ta['value_target'] in _TARGETS, ta['value_target']
     assert ta['forward_steps'] >= 1
     assert ta['burn_in_steps'] >= 0
     assert ta['compress_steps'] >= 1

@@ -399,6 +399,10 @@ def force_cpu_backend():
         jax.config.update('jax_platforms', 'cpu')
     except Exception:
         pass
+    # spawned children start with a fresh interpreter: re-enable the shared
+    # compile cache so their (CPU) compiles are one-time across the fleet
+    from . import setup_compile_cache
+    setup_compile_cache()
 
 
 def spawn_pipe_workers(count: int, target: Callable,

@@ -36,7 +36,10 @@ def recency_slots(key, size, cursor, capacity: int, batch_size: int):
     definition.
     """
     u = jax.random.uniform(key, (batch_size,))
-    idx = jnp.minimum((jnp.sqrt(u) * size).astype(jnp.int32), size - 1)
+    # clamp to 0 so size==0 yields slot 0 instead of wrapping to capacity-1
+    # and silently sampling uninitialized windows; callers must still gate
+    # training on size > 0 (the drawn window is all-zeros either way)
+    idx = jnp.clip((jnp.sqrt(u) * size).astype(jnp.int32), 0, jnp.maximum(size - 1, 0))
     # ring order: oldest window sits at cursor when full
     start = jnp.where(size >= capacity, cursor, 0)
     return (start + idx) % capacity

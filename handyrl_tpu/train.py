@@ -187,8 +187,12 @@ class Trainer:
             # 'replay_windows_per_episode' (default assumes ~64-step episodes)
             windows_per_ep = (args.get('replay_windows_per_episode')
                               or max(1, 64 // args['forward_steps']))
+            # hard cap on total ring windows: long-episode envs (200-ply
+            # geese at forward_steps 4 => 50 windows/ep) must not scale the
+            # HBM ring past a few GB; 49152 geese windows ~= 4 GB fp32
             self.replay = DeviceReplay(
-                capacity=min(args['maximum_episodes'], 4096) * windows_per_ep,
+                capacity=min(min(args['maximum_episodes'], 4096)
+                             * windows_per_ep, 49152),
                 mesh=self.mesh)
             self.ingest_queue = queue.Queue(maxsize=1024)
             self._pending_rows: List[Dict[str, Any]] = []
@@ -510,6 +514,9 @@ class Learner:
         train_args['env'] = env_args
         args = train_args
 
+        from . import setup_compile_cache
+        setup_compile_cache()
+
         self.args = args
         random.seed(args['seed'])
 
@@ -543,6 +550,20 @@ class Learner:
         self.results: Dict[int, tuple] = {}
         self.results_per_opponent: Dict[int, dict] = {}
         self.num_results = 0
+
+        # Resolve the per-episode replay-window budget ONCE, from the env's
+        # true episode length, so the device windower's per-episode cap and
+        # the host ingest rate (both ~steps/forward_steps windows) agree —
+        # the default of 64//forward_steps silently under-sampled long
+        # episodes (a 200-ply goose yielded 4 windows instead of 12).
+        if args.get('device_replay') and not args.get('replay_windows_per_episode'):
+            from .environment import make_jax_env
+            twin = make_jax_env(env_args)
+            if twin is not None:
+                max_steps = int(getattr(twin, 'MAX_STEPS',
+                                        getattr(twin, 'MAX_PLIES', 64)))
+                args['replay_windows_per_episode'] = max(
+                    1, max_steps // args['forward_steps'])
 
         self.remote = remote
         self.use_batched_generation = (not remote
@@ -593,7 +614,14 @@ class Learner:
             if episode is None:
                 continue
             for p in episode['args']['player']:
-                model_id = self.model_epoch
+                # attribute stats to the model that actually generated the
+                # episode (the reference books everything under the current
+                # epoch — its correct line is commented out at
+                # train.py:461-462; with chunked generation spanning epoch
+                # boundaries that skew would only grow)
+                model_id = (episode['args'].get('model_id') or {}).get(p, -1)
+                if model_id is None or model_id < 0:
+                    model_id = self.model_epoch
                 outcome = episode['outcome'][p]
                 n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
                 self.generation_results[model_id] = (n + 1, r + outcome,
@@ -630,15 +658,19 @@ class Learner:
         while len(self.trainer.episodes) > maximum_episodes:
             self.trainer.episodes.popleft()
 
-    def feed_device_chunk(self, done, outcome) -> int:
+    def feed_device_chunk(self, done, outcome,
+                          model_id: Optional[int] = None) -> int:
         """Episode accounting for device-ingested rollout chunks: only the
         (done, outcome) arrays reach the host — trajectories stay in HBM
         (ops/device_windows.py). Mirrors feed_episodes' generation stats
-        (every player's outcome counts, feed over args['player'])."""
+        (every player's outcome counts, feed over args['player']).
+        ``model_id`` is the epoch whose params generated the chunk, captured
+        by the caller at dispatch time so stats survive epoch boundaries."""
+        if model_id is None:
+            model_id = self.model_epoch
         ks, envs = np.nonzero(done)
         num_players = outcome.shape[-1]
         for k, i in zip(ks, envs):
-            model_id = self.model_epoch
             for p in range(num_players):
                 oc = float(outcome[k, i, p])
                 n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
@@ -814,9 +846,10 @@ class Learner:
 
         while not self.shutdown_flag:
             actor.params = self.wrapper.params   # follow latest epoch
+            gen_epoch = self.model_epoch         # the params' true epoch
             if device_ingest:
                 records, done, outcome = gen.step_chunk_records()
-                self.feed_device_chunk(done, outcome)
+                self.feed_device_chunk(done, outcome, gen_epoch)
                 self.trainer.seen_episodes = self.num_returned_episodes
                 # BLOCKING hand-off: the windower's per-env histories track
                 # a contiguous ply stream, so dropping a chunk would splice
@@ -833,6 +866,12 @@ class Learner:
                 episodes = gen.step()
                 for ep in episodes:
                     self.num_episodes += 1
+                    # in-process generators leave model_id unset (-1): stamp
+                    # the epoch whose params played the episode
+                    mid = ep['args'].setdefault('model_id', {})
+                    for p, v in list(mid.items()):
+                        if v is None or v < 0:
+                            mid[p] = gen_epoch
                 self.feed_episodes(episodes)
 
             # keep the evaluation share near eval_rate. The host evaluator

@@ -25,6 +25,9 @@ def main():
         import jax
         jax.config.update('jax_platforms', 'cpu')
 
+    from handyrl_tpu import setup_compile_cache
+    setup_compile_cache()
+
     args = load_config('config.yaml')
     print(args)
 

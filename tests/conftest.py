@@ -44,6 +44,12 @@ import signal  # noqa: E402
 
 import pytest  # noqa: E402
 
+from handyrl_tpu import setup_compile_cache  # noqa: E402
+
+# the suite re-traces the same programs constantly; package import is
+# side-effect free, so the persistent compile cache is enabled here
+setup_compile_cache()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -51,7 +57,25 @@ def pytest_configure(config):
         'timeout(seconds): fail the test if it runs longer than the deadline')
 
 
+# Socket/multiprocess integration tests rely on POSIX semantics (SIGALRM
+# hang watchdog, spawn+pipe teardown timing); on the windows CI leg they are
+# skipped — the unit/oracle/golden suite still runs there in full.
+_POSIX_ONLY_FILES = (
+    'test_remote_cluster.py', 'test_network.py', 'test_cluster.py',
+    'test_cli.py', 'test_eval_cli.py', 'test_multihost.py',
+    'test_batcher_processes.py', 'test_stress.py',
+)
+
+
 def pytest_collection_modifyitems(config, items):
+    import sys
+    if sys.platform == 'win32':
+        skip_win = pytest.mark.skip(
+            reason='POSIX-only integration test (SIGALRM watchdog / '
+                   'spawn+socket teardown semantics)')
+        for item in items:
+            if os.path.basename(str(item.fspath)) in _POSIX_ONLY_FILES:
+                item.add_marker(skip_win)
     if not _TPU_MODE:
         return
     skip = pytest.mark.skip(
