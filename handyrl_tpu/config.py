@@ -46,6 +46,8 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
     'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)
     'replay_fused_steps': 8,      # SGD steps fused into one device program in device_replay mode
+    'fused_pipeline': True,       # one dispatch = rollout chunk + ingest + K SGD steps (device_ingest configs)
+    'sgd_steps_per_chunk': None,  # fused-pipeline SGD steps per rollout chunk (pins the replay ratio); None = 16
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id

@@ -98,42 +98,18 @@ def _reset_hidden_where_done(hidden, done):
                             jnp.zeros_like(h), h), hidden)
 
 
-def _ply_inference_observe_all(env_mod, apply_fn, recurrent, num_players,
-                               params, state, hidden):
-    """Turn-based env, observation=True: EVERY player observes each ply
-    from its own perspective (env_mod.observe_as) and advances its own
-    recurrent state — the host generator's behavior (each observing seat
-    runs inference per ply, reference generation.py:23-46). Only the turn
-    player's policy row is used for the action.
-
-    Returns (obs (N,P,...), logits (N,A), amask (N,A), value (N,P,1),
-    hidden, player (N,)).
-    """
-    player = env_mod.turn(state)
-    N = player.shape[0]
-    P = num_players
-    views = [env_mod.observe_as(state, jnp.full((N,), p, jnp.int32))
-             for p in range(P)]
-    obs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=1), *views)
-    flat = jax.tree_util.tree_map(
-        lambda o: o.reshape((N * P,) + o.shape[2:]), obs)
-    if recurrent:
-        h_in = jax.tree_util.tree_map(
-            lambda h: h.reshape((N * P,) + h.shape[2:]), hidden)
-        out = dict(apply_fn(params, flat, h_in))
-        nh = out.pop('hidden')
-        hidden = jax.tree_util.tree_map(
-            lambda h: h.reshape((N, P) + h.shape[1:]), nh)
-    else:
-        out = dict(apply_fn(params, flat, None))
-    legal = env_mod.legal_mask(state)                 # (N, A), turn player
-    amask = (1.0 - legal) * 1e32
-    policy = out['policy'].reshape(N, P, -1)
-    logits = policy[jnp.arange(N), player] - amask
-    value = out.get('value')
-    if value is not None:
-        value = value.reshape(N, P, -1)
-    return obs, logits, amask, value, hidden, player
+# NOTE on observation=True for turn-based envs (the geister-device config):
+# the reference generator runs inference ONLY for ``turn_players +
+# observers`` each ply (reference generation.py:37-41), and no reference env
+# ever overrides ``observers()`` (it defaults to [] — reference
+# environment.py:84); the eval-side Agent likewise advances its hidden only
+# on its own turns (reference evaluation.py:97-101). So even with
+# observation=True, exactly the acting seat observes per ply — the flag only
+# widens the BATCH layout to the full player axis (reference train.py:65-68)
+# with observation_mask marking the acting seat. The acting-seat-only
+# recording below is therefore already reference-exact; an earlier
+# "observe-all" helper that ran inference for every seat per ply was removed
+# as anti-parity (tests/test_geister_device_parity.py pins the semantics).
 
 
 def _init_rollout_engine(engine, env_mod, wrapper, n_envs: int, seed: int):
@@ -154,8 +130,73 @@ def _init_rollout_engine(engine, env_mod, wrapper, n_envs: int, seed: int):
         (n_envs, env_mod.NUM_PLAYERS)) if engine.recurrent else None)
 
 
+def make_gen_body(env_mod, apply_fn, recurrent: bool, simultaneous: bool):
+    """The one self-play ply: inference, sampling, transition, record.
+
+    Shared between DeviceGenerator's standalone rollout program and the
+    fused generate+ingest+train pipeline (ops/fused_pipeline.py) so the
+    recorded trajectory semantics have exactly one definition.
+    Carry is (env_state, hidden, rng); emits the per-ply record dict.
+
+    The ply body is (re)defined inside ``rollout_chunk`` so it closes over
+    the CURRENT trace's params: lax.scan caches traced bodies by function
+    identity, and a body shared across traces would smuggle one trace's
+    param tracers into the next (UnexpectedTracerError).
+    """
+    def rollout_chunk(params, state, hidden, rng, chunk_steps: int):
+        def body(carry, _):
+            state, hidden, rng = carry
+            obs, logits, amask, hidden, out = _ply_inference(
+                env_mod, apply_fn, recurrent, simultaneous,
+                params, state, hidden)
+            rng, key = jax.random.split(rng)
+            actions = jax.random.categorical(key, logits)
+            probs = jax.nn.softmax(logits, axis=-1)
+            sel = jnp.take_along_axis(probs, actions[..., None],
+                                      axis=-1)[..., 0]
+            if simultaneous:
+                N, P = obs.shape[:2]
+                value = out.get('value')
+                if value is not None:
+                    value = value.reshape(N, P, -1)
+                act_mask = env_mod.acting(state)           # (N, P)
+                nstate = env_mod.step(state, actions)
+                done = env_mod.terminal(nstate)
+                record = {'obs': obs, 'action': actions, 'prob': sel,
+                          'amask': amask, 'value': value,
+                          'acting': act_mask, 'done': done,
+                          'outcome': env_mod.outcome(nstate)}
+            else:
+                player = env_mod.turn(state)
+                nstate = env_mod.step(state, actions)
+                done = env_mod.terminal(nstate)
+                record = {'obs': obs, 'action': actions, 'prob': sel,
+                          'amask': amask, 'value': out.get('value'),
+                          'player': player, 'done': done,
+                          'outcome': env_mod.outcome(nstate)}
+            if hasattr(env_mod, 'rewards'):
+                record['reward'] = env_mod.rewards(nstate)   # (N, P)
+            nstate = env_mod.auto_reset(nstate, done)
+            if recurrent:
+                hidden = _reset_hidden_where_done(hidden, done)
+            return (nstate, hidden, rng), record
+
+        (state, hidden, rng), records = jax.lax.scan(
+            body, (state, hidden, rng), None, length=chunk_steps)
+        return state, hidden, rng, dict(records)
+
+    return rollout_chunk
+
+
 class DeviceGenerator:
-    """Runs chunks of device-resident self-play for a pure-JAX env module."""
+    """Runs chunks of device-resident self-play for a pure-JAX env module.
+
+    Dispatch is PIPELINED one chunk deep: each ``step_chunk*`` call enqueues
+    the NEXT rollout program before fetching the previous chunk's results,
+    so the host-visible round-trip latency (dominant on a tunneled TPU)
+    overlaps with device execution of the following chunk. Callers see a
+    one-chunk delay in episode accounting, nothing else.
+    """
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
                  n_envs: int = 256, chunk_steps: int = 16, seed: int = 0):
@@ -163,55 +204,23 @@ class DeviceGenerator:
         self.chunk_steps = chunk_steps
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         self._partials: List[List[dict]] = [[] for _ in range(n_envs)]
+        self._pending = None
+        self.dispatches = 0
 
-        apply_fn = wrapper.module.apply
-        simultaneous = self.simultaneous
-        recurrent = self.recurrent
+        rollout_chunk = make_gen_body(env_mod, wrapper.module.apply,
+                                      self.recurrent, self.simultaneous)
 
         @jax.jit
         def rollout(params, state, hidden, rng):
-            def body(carry, _):
-                state, hidden, rng = carry
-                obs, logits, amask, hidden, out = _ply_inference(
-                    env_mod, apply_fn, recurrent, simultaneous,
-                    params, state, hidden)
-                rng, key = jax.random.split(rng)
-                actions = jax.random.categorical(key, logits)
-                probs = jax.nn.softmax(logits, axis=-1)
-                sel = jnp.take_along_axis(probs, actions[..., None],
-                                          axis=-1)[..., 0]
-                if simultaneous:
-                    N, P = obs.shape[:2]
-                    value = out.get('value')
-                    if value is not None:
-                        value = value.reshape(N, P, -1)
-                    act_mask = env_mod.acting(state)           # (N, P)
-                    nstate = env_mod.step(state, actions)
-                    done = env_mod.terminal(nstate)
-                    record = {'obs': obs, 'action': actions, 'prob': sel,
-                              'amask': amask, 'value': value,
-                              'acting': act_mask, 'done': done,
-                              'outcome': env_mod.outcome(nstate)}
-                else:
-                    player = env_mod.turn(state)
-                    nstate = env_mod.step(state, actions)
-                    done = env_mod.terminal(nstate)
-                    record = {'obs': obs, 'action': actions, 'prob': sel,
-                              'amask': amask, 'value': out.get('value'),
-                              'player': player, 'done': done,
-                              'outcome': env_mod.outcome(nstate)}
-                if hasattr(env_mod, 'rewards'):
-                    record['reward'] = env_mod.rewards(nstate)   # (N, P)
-                nstate = env_mod.auto_reset(nstate, done)
-                if recurrent:
-                    hidden = _reset_hidden_where_done(hidden, done)
-                return (nstate, hidden, rng), record
-
-            (state, hidden, rng), records = jax.lax.scan(
-                body, (state, hidden, rng), None, length=chunk_steps)
-            return state, hidden, rng, records
+            return rollout_chunk(params, state, hidden, rng, chunk_steps)
 
         self._rollout = rollout
+
+    def _dispatch(self):
+        self.state, self.hidden, self.rng, records = self._rollout(
+            self.wrapper.params, self.state, self.hidden, self.rng)
+        self.dispatches += 1
+        return dict(records)
 
     def step_chunk_records(self):
         """Run one compiled chunk, keeping the trajectory ON DEVICE.
@@ -221,20 +230,40 @@ class DeviceGenerator:
         copies of ONLY the tiny done/outcome arrays for episode accounting.
         The heavy leaves (observations, masks) never reach the host.
         """
-        self.state, self.hidden, self.rng, records = self._rollout(
-            self.wrapper.params, self.state, self.hidden, self.rng)
-        records = dict(records)
+        if self._pending is None:
+            self._pending = self._dispatch()
+        records, self._pending = self._pending, self._dispatch()
         done = np.asarray(records['done'])
         outcome = np.asarray(records['outcome'])
         return records, done, outcome
 
+    def drain_records(self):
+        """Fetch the in-flight speculative chunk at loop shutdown (device-
+        ingest mode); returns (records, done, outcome) or None."""
+        if self._pending is None:
+            return None
+        records, self._pending = self._pending, None
+        return records, np.asarray(records['done']), \
+            np.asarray(records['outcome'])
+
     # -- host-side episode splicing ---------------------------------------
     def step_chunk(self) -> List[dict]:
         """Run one compiled chunk; return episodes completed within it."""
-        self.state, self.hidden, self.rng, records = self._rollout(
-            self.wrapper.params, self.state, self.hidden, self.rng)
+        if self._pending is None:
+            self._pending = self._dispatch()
+        records, self._pending = self._pending, self._dispatch()
+        return self._splice(records)
+
+    def drain_episodes(self) -> List[dict]:
+        """Splice the in-flight speculative chunk at loop shutdown."""
+        if self._pending is None:
+            return []
+        records, self._pending = self._pending, None
+        return self._splice(records)
+
+    def _splice(self, records) -> List[dict]:
         rec = map_structure(lambda v: None if v is None else np.asarray(v),
-                            dict(records))
+                            records)
         players = list(range(self.env_mod.NUM_PLAYERS))
         episodes: List[dict] = []
         for k in range(self.chunk_steps):
@@ -327,6 +356,8 @@ class DeviceEvaluator:
         # one evaluated seat per env, rotated on every reset so first/second
         # (and every goose slot) are balanced like evaluate_mp's scheduler
         self.seat = jnp.arange(n_envs, dtype=jnp.int32) % env_mod.NUM_PLAYERS
+        self._pending = None
+        self.dispatches = 0
 
         apply_fn = wrapper.module.apply
         simultaneous = self.simultaneous
@@ -365,12 +396,35 @@ class DeviceEvaluator:
 
         self._rollout = rollout
 
-    def step(self) -> List[dict]:
-        """One compiled chunk; returns finished eval result records (the
-        same shape Learner.feed_results consumes from BatchedEvaluator)."""
+    # results arrive one dispatch late: Learner.feed_results must use the
+    # dispatch-time epoch for attribution
+    pipelined = True
+
+    def _dispatch(self):
         self.state, self.hidden, self.seat, self.rng, records = \
             self._rollout(self.wrapper.params, self.state, self.hidden,
                           self.seat, self.rng)
+        self.dispatches += 1
+        return dict(records)
+
+    def step(self) -> List[dict]:
+        """One compiled chunk; returns finished eval result records (the
+        same shape Learner.feed_results consumes from BatchedEvaluator).
+        Pipelined one chunk deep like DeviceGenerator: the next chunk is
+        enqueued before the previous one's outcome arrays are fetched."""
+        if self._pending is None:
+            self._pending = self._dispatch()
+        records, self._pending = self._pending, self._dispatch()
+        return self._collect(records)
+
+    def drain(self) -> List[dict]:
+        """Collect the in-flight speculative chunk at loop shutdown."""
+        if self._pending is None:
+            return []
+        records, self._pending = self._pending, None
+        return self._collect(records)
+
+    def _collect(self, records) -> List[dict]:
         done = np.asarray(records['done'])
         seats = np.asarray(records['seat'])
         outcomes = np.asarray(records['outcome'])

@@ -239,8 +239,17 @@ class DeviceWindower:
     # -- the ingest program ------------------------------------------------
     def ingest(self, records, state, ring, cursor, size, rng):
         if self._ingest is None:
-            self._ingest = self._build_ingest()
+            # donate history/ring/cursor/size/rng: the trainer thread is the
+            # single owner and always rebinds them from the outputs
+            self._ingest = jax.jit(self.ingest_fn(),
+                                   donate_argnums=(1, 2, 3, 4, 5))
         return self._ingest(records, state, ring, cursor, size, rng)
+
+    def ingest_fn(self):
+        """The pure (un-jitted) chunk-ingest function — used by the jitted
+        standalone path above and inlined into the fused
+        generate+ingest+train program (ops/fused_pipeline.py)."""
+        return self._build_ingest()
 
     def _build_ingest(self):
         fs, bi, L, W, cap = self.fs, self.bi, self.L, self.W, self.capacity
@@ -329,6 +338,4 @@ class DeviceWindower:
             return ({'hist': hist, 'counts': counts}, ring, cursor, size,
                     rng, jnp.sum(dones), jnp.sum(wins))
 
-        # donate history/ring/cursor/size/rng: the trainer thread is the
-        # single owner and always rebinds them from the outputs
-        return jax.jit(ingest, donate_argnums=(1, 2, 3, 4, 5))
+        return ingest

@@ -1,0 +1,154 @@
+"""The fully-fused device pipeline: ONE dispatch = rollout chunk + window
+ingest + K SGD steps.
+
+The split pipeline (DeviceGenerator dispatch -> chunk queue -> trainer-thread
+ingest dispatch -> fused-update dispatch) keeps the whole loop on device, but
+still pays one host round trip per program, and the generation thread's tiny
+done/outcome fetch queues BEHIND the trainer thread's in-flight programs on
+the single device stream — on a tunneled TPU that serialization, not
+compute, bounds episodes/sec.
+
+Here the entire steady-state loop body is one XLA program:
+
+    rollout chunk (lax.scan over plies, make_gen_body)
+      -> windower chunk ingest (episode windows scattered into the HBM ring)
+      -> K SGD steps (recency-biased on-device sampling, EMA lr schedule)
+
+The host dispatches it once per chunk and fetches only the previous chunk's
+(done, outcome) arrays plus lazily-drained loss metrics. Actor params enter
+as a replicated input refreshed once per epoch (self-play acts with the
+epoch snapshot while the optimizer advances continuously, exactly like the
+reference's worker/learner split, train.py:605-615); training params/opt
+state are donated through every dispatch.
+
+A second, SGD-free program covers the minimum_episodes warmup so the steps
+counter and Adam state never see empty-ring batches.
+
+Sample-reuse note: steps-per-chunk is a DIAL (sgd_steps_per_chunk), making
+the replay ratio explicit: reuse ~= sgd_steps * batch_size / windows-per-
+chunk. The threaded mode's reuse is implicit (however fast the trainer spins
+vs generation); here it is pinned and logged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..device_generation import _init_rollout_engine, make_gen_body
+from .losses import LossConfig
+from .replay import recency_slots
+from .train_step import TrainState, _update_core, make_optimizer
+
+
+class FusedPipeline:
+    """Owns the device-resident loop state (env vector, recurrent hidden,
+    windower history, HBM ring) and the two compiled programs (warmup /
+    steady). The caller owns the TrainState and actor params."""
+
+    def __init__(self, env_mod, wrapper, cfg: LossConfig, windower,
+                 args: Dict[str, Any], n_envs: int, chunk_steps: int,
+                 sgd_steps: int, batch_size: int,
+                 default_lr: float = 3e-8, seed: int = 0):
+        self.chunk_steps = chunk_steps
+        self.sgd_steps = sgd_steps
+        _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
+        rollout_chunk = make_gen_body(env_mod, wrapper.module.apply,
+                                      self.recurrent, self.simultaneous)
+        ingest = windower.ingest_fn()
+        update = _update_core(wrapper.module, cfg, make_optimizer())
+        capacity = windower.capacity
+        self.capacity = capacity
+        self.dispatches = 0
+
+        # ring/windower state allocated from the record shapes (eval_shape:
+        # nothing runs on device for this)
+        rec_spec = jax.eval_shape(
+            lambda p, s, h, r: rollout_chunk(p, s, h, r, chunk_steps),
+            wrapper.params, self.state, self.hidden, self.rng)[3]
+        self.wstate = windower.init_state(rec_spec)
+        self.ring = windower.init_ring(rec_spec)
+        self.cursor = jnp.zeros((), jnp.int32)
+        self.size = jnp.zeros((), jnp.int32)
+
+        def gen_ingest(actor_params, env_state, hidden, wstate, ring,
+                       cursor, size, rng):
+            env_state, hidden, rng, records = rollout_chunk(
+                actor_params, env_state, hidden, rng, chunk_steps)
+            (wstate, ring, cursor, size, rng,
+             n_done, n_win) = ingest(records, wstate, ring, cursor, size, rng)
+            return (env_state, hidden, wstate, ring, cursor, size, rng,
+                    records['done'], records['outcome'], n_win)
+
+        def fused(actor_params, train_state: TrainState, env_state, hidden,
+                  wstate, ring, cursor, size, rng, data_cnt_ema):
+            (env_state, hidden, wstate, ring, cursor, size, rng,
+             done, outcome, n_win) = gen_ingest(
+                actor_params, env_state, hidden, wstate, ring, cursor,
+                size, rng)
+
+            def body(carry, _):
+                ts, key = carry
+                key, sub = jax.random.split(key)
+                slots = recency_slots(sub, size, cursor, capacity,
+                                      batch_size)
+                batch = jax.tree_util.tree_map(lambda b: b[slots], ring)
+                lr = (default_lr * data_cnt_ema
+                      / (1 + ts.steps.astype(jnp.float32) * 1e-5))
+                ts, metrics = update(ts, batch, lr)
+                return (ts, key), metrics
+
+            (train_state, rng), stacked = jax.lax.scan(
+                body, (train_state, rng), None, length=sgd_steps)
+            metrics = jax.tree_util.tree_map(
+                lambda m: jnp.sum(m, axis=0), stacked)
+            return (train_state, env_state, hidden, wstate, ring, cursor,
+                    size, rng, done, outcome, n_win, metrics)
+
+        # donate everything the pipeline owns plus the train state; actor
+        # params and the EMA scalar are plain (re-used) inputs
+        self._warmup = jax.jit(gen_ingest,
+                               donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+        self._fused = jax.jit(fused,
+                              donate_argnums=tuple(range(1, 10)))
+        self._pending = None   # (done, outcome) device arrays, one deep
+
+    # -- dispatch helpers --------------------------------------------------
+    def _flip(self, done, outcome):
+        """Pipeline the tiny per-chunk fetch one dispatch deep."""
+        prev, self._pending = self._pending, (done, outcome)
+        self.dispatches += 1
+        if prev is None:
+            return None
+        return np.asarray(prev[0]), np.asarray(prev[1])
+
+    def warm_step(self, actor_params):
+        """Generation+ingest only (pre-minimum_episodes). Returns host
+        (done, outcome) of the PREVIOUS chunk, or None on the first call."""
+        (self.state, self.hidden, self.wstate, self.ring, self.cursor,
+         self.size, self.rng, done, outcome, _n_win) = self._warmup(
+            actor_params, self.state, self.hidden, self.wstate, self.ring,
+            self.cursor, self.size, self.rng)
+        return self._flip(done, outcome)
+
+    def train_step(self, actor_params, train_state: TrainState,
+                   data_cnt_ema: float):
+        """One fused chunk+ingest+K-SGD-steps dispatch. Returns
+        (train_state, prev_done_outcome_or_None, metrics_future)."""
+        (train_state, self.state, self.hidden, self.wstate, self.ring,
+         self.cursor, self.size, self.rng, done, outcome, _n_win,
+         metrics) = self._fused(
+            actor_params, train_state, self.state, self.hidden, self.wstate,
+            self.ring, self.cursor, self.size, self.rng,
+            jnp.asarray(data_cnt_ema, jnp.float32))
+        return train_state, self._flip(done, outcome), metrics
+
+    def drain(self):
+        """Fetch the last in-flight chunk's accounting (loop shutdown)."""
+        if self._pending is None:
+            return None
+        prev, self._pending = self._pending, None
+        return np.asarray(prev[0]), np.asarray(prev[1])

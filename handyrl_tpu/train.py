@@ -504,6 +504,23 @@ class Trainer:
         self.batcher.stop()
 
 
+class _EpochCadence:
+    """Epoch trigger shared by every generation front-end: an epoch is due
+    every ``update_episodes`` returned episodes past the warmup minimum
+    (reference train.py:621-626). One definition so the fused, threaded and
+    RPC-server loops cannot drift apart."""
+
+    def __init__(self, args: Dict[str, Any]):
+        self._next = args['minimum_episodes'] + args['update_episodes']
+        self._step = args['update_episodes']
+
+    def due(self, returned_episodes: int) -> bool:
+        if returned_episodes >= self._next:
+            self._next += self._step
+            return True
+        return False
+
+
 class Learner:
     """Central conductor: owns the model, episode/eval accounting, epoch
     cadence, checkpoints, and the generation front-end."""
@@ -682,12 +699,17 @@ class Learner:
                 print(self.num_returned_episodes, end=' ', flush=True)
         return len(ks)
 
-    def feed_results(self, results: List[Optional[dict]]):
+    def feed_results(self, results: List[Optional[dict]],
+                     model_id: Optional[int] = None):
+        """``model_id`` lets pipelined device evaluators attribute results
+        to the epoch whose params were actually playing when the chunk was
+        dispatched (they deliver results one dispatch late)."""
+        if model_id is None:
+            model_id = self.model_epoch
         for result in results:
             if result is None:
                 continue
             for p in result['args']['player']:
-                model_id = self.model_epoch
                 res = result['result'][p]
                 n, r, r2 = self.results.get(model_id, (0, 0, 0))
                 self.results[model_id] = (n + 1, r + res, r2 + res ** 2)
@@ -700,33 +722,8 @@ class Learner:
     def update(self):
         print()
         print('epoch %d' % self.model_epoch)
-
-        if self.model_epoch not in self.results:
-            print('win rate = Nan (0)')
-        else:
-            def output_wp(name, results):
-                n, r, r2 = results
-                mean = r / (n + 1e-6)
-                name_tag = ' (%s)' % name if name != '' else ''
-                print('win rate%s = %.3f (%.1f / %d)'
-                      % (name_tag, (mean + 1) / 2, (r + n) / 2, n))
-
-            keys = self.results_per_opponent[self.model_epoch]
-            if (len(self.args.get('eval', {}).get('opponent', [])) <= 1
-                    and len(keys) <= 1):
-                output_wp('', self.results[self.model_epoch])
-            else:
-                output_wp('total', self.results[self.model_epoch])
-                for key in sorted(keys):
-                    output_wp(key, keys[key])
-
-        if self.model_epoch not in self.generation_results:
-            print('generation stats = Nan (0)')
-        else:
-            n, r, r2 = self.generation_results[self.model_epoch]
-            mean = r / (n + 1e-6)
-            std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
-            print('generation stats = %.3f +- %.3f' % (mean, std))
+        self._print_eval_stats()
+        self._print_generation_stats()
 
         params, steps, state_blob = self.trainer.update()
         if params is None and self.trainer.failed:
@@ -739,13 +736,15 @@ class Learner:
         self._write_metrics(steps)
         self.flags = set()
 
-    def _write_metrics(self, steps: int):
+    def _write_metrics(self, steps: int, extra: Optional[dict] = None):
         if not self._metrics_path:
             return
         rec = {'epoch': self.model_epoch, 'steps': steps,
                'episodes': self.num_returned_episodes, 'time': time.time(),
                'sgd_steps_per_sec': round(self.trainer.last_steps_per_sec, 3),
                'buffer': len(self.trainer.episodes)}
+        if extra:
+            rec.update(extra)
         gen = self.generation_results.get(self.model_epoch - 1)
         if gen:
             n, r, _ = gen
@@ -764,37 +763,65 @@ class Learner:
         with open(self._metrics_path, 'a') as f:
             f.write(json.dumps(rec) + '\n')
 
+    def _run_eval_share(self, evaluator, tracker: Dict[str, int]):
+        """Advance online evaluation until its share of episodes reaches
+        eval_rate. The host evaluator advances all its matches ONE ply per
+        call while chunked generators deliver episodes in bursts, so it gets
+        several plies per loop iteration or it never finishes a match; the
+        device evaluator finishes whole batches per call and exits after one
+        step once the share is met. ``tracker`` carries the previous
+        dispatch's epoch for pipelined evaluators (their results arrive one
+        dispatch late)."""
+        pipelined = getattr(evaluator, 'pipelined', False)
+        for _ in range(16):
+            if self.num_results >= self.eval_rate * self.num_episodes:
+                break
+            cur = self.model_epoch
+            results = evaluator.step()
+            self.num_results += len(results)
+            self.feed_results(
+                results,
+                model_id=tracker.get('prev', cur) if pipelined else cur)
+            tracker['prev'] = cur
+
     # -- generation front-end A: in-process batched self-play -------------
     def _run_batched(self):
         """TPU-first local mode: vectorized self-play + interleaved eval in
         this process; no worker processes at all."""
         args = self.args
         actor = ModelWrapper(self.wrapper.module)
-        actor.params = self.wrapper.params
+        # actor params live ON DEVICE, refreshed once per epoch — binding
+        # the learner's numpy copy would re-upload the full parameter set
+        # on every rollout/eval dispatch (ruinous through a WAN tunnel)
+        actor.params = jax.device_put(self.wrapper.params)
         env_args = args['env']
 
         def make_env_fn(i):
             e = make_env({**env_args, 'id': i})
             return e
 
-        gen = None
         env_mod = None
         chunk_steps = int(args.get('device_chunk_steps') or 16)
         if args.get('device_generation'):
             from .environment import make_jax_env
-            from .device_generation import DeviceGenerator
             env_mod = make_jax_env(env_args)
-            if env_mod is not None:
-                gen = DeviceGenerator(env_mod, actor, args,
-                                      n_envs=args.get('generation_envs', 64),
-                                      chunk_steps=chunk_steps)
-                gen.step = gen.step_chunk   # same streaming surface
-            else:
+            if env_mod is None:
                 print('no pure-JAX twin for %s; falling back to host envs'
                       % env_args['env'])
-        if gen is None:
-            gen = BatchedGenerator(make_env_fn, actor, args,
-                                   n_envs=args.get('generation_envs', 64))
+
+        # device-ingest layout (when the env/config allows assembling
+        # training windows on device, ops/device_windows.py)
+        ingest_mode = None
+        if (env_mod is not None and args.get('device_replay')
+                and args.get('device_ingest', True)
+                and self.trainer.mesh is None):
+            simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+            if simultaneous and not args['turn_based_training']:
+                ingest_mode = 'solo'
+            elif (not simultaneous and args['turn_based_training']
+                  and not args['observation']):
+                ingest_mode = 'turn'
+
         eval_envs = int(args.get('eval_envs')
                         or max(4, args.get('generation_envs', 64) // 8))
         opponents = args.get('eval', {}).get('opponent', []) or ['random']
@@ -810,46 +837,73 @@ class Learner:
             evaluator = BatchedEvaluator(make_env_fn, actor, args,
                                          n_envs=eval_envs)
 
+        def build_windower(mode):
+            from .ops.device_windows import DeviceWindower
+            max_steps = int(getattr(env_mod, 'MAX_STEPS',
+                                    getattr(env_mod, 'MAX_PLIES', 256)))
+            windows_cap = (args.get('replay_windows_per_episode')
+                           or max(1, 64 // args['forward_steps']))
+            return DeviceWindower(
+                mode=mode, fs=args['forward_steps'],
+                bi=args['burn_in_steps'], max_steps=max_steps,
+                windows_cap=windows_cap,
+                capacity=self.trainer.replay.capacity,
+                num_players=env_mod.NUM_PLAYERS, gamma=args['gamma'],
+                has_reward=hasattr(env_mod, 'rewards'))
+
+        if ingest_mode is not None and args.get('fused_pipeline', True):
+            # the fully-fused loop: rollout + ingest + K SGD steps per
+            # dispatch, driven single-threaded (ops/fused_pipeline.py)
+            return self._run_fused(env_mod, actor, evaluator,
+                                   build_windower(ingest_mode), ingest_mode)
+
+        gen = None
+        if env_mod is not None:
+            from .device_generation import DeviceGenerator
+            gen = DeviceGenerator(env_mod, actor, args,
+                                  n_envs=args.get('generation_envs', 64),
+                                  chunk_steps=chunk_steps)
+            gen.step = gen.step_chunk   # same streaming surface
+        if gen is None:
+            gen = BatchedGenerator(make_env_fn, actor, args,
+                                   n_envs=args.get('generation_envs', 64))
+
         # device ingest: trajectories never leave the accelerator — rollout
         # records flow straight into the windower's HBM ring; the host does
         # episode accounting from the (done, outcome) arrays only
         device_ingest = False
-        if (env_mod is not None and args.get('device_replay')
-                and args.get('device_ingest', True)
-                and self.trainer.mesh is None):
-            simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
-            mode = None
-            if simultaneous and not args['turn_based_training']:
-                mode = 'solo'
-            elif (not simultaneous and args['turn_based_training']
-                  and not args['observation']):
-                mode = 'turn'
-            if mode is not None:
-                from .ops.device_windows import DeviceWindower
-                max_steps = int(getattr(env_mod, 'MAX_STEPS',
-                                        getattr(env_mod, 'MAX_PLIES', 256)))
-                windows_cap = (args.get('replay_windows_per_episode')
-                               or max(1, 64 // args['forward_steps']))
-                self.trainer.windower = DeviceWindower(
-                    mode=mode, fs=args['forward_steps'],
-                    bi=args['burn_in_steps'], max_steps=max_steps,
-                    windows_cap=windows_cap,
-                    capacity=self.trainer.replay.capacity,
-                    num_players=env_mod.NUM_PLAYERS, gamma=args['gamma'],
-                    has_reward=hasattr(env_mod, 'rewards'))
-                device_ingest = True
-                print('device ingest: windows assembled on device '
-                      '(%s mode)' % mode)
+        if ingest_mode is not None:
+            self.trainer.windower = build_windower(ingest_mode)
+            device_ingest = True
+            print('device ingest: windows assembled on device '
+                  '(%s mode)' % ingest_mode)
 
-        prev_update_episodes = args['minimum_episodes']
-        next_update_episodes = prev_update_episodes + args['update_episodes']
+        cadence = _EpochCadence(args)
+        actor_epoch = self.model_epoch
+        # pipelined generators return the PREVIOUS dispatch's chunk: stamp
+        # episodes with the epoch captured when that chunk was dispatched
+        chunk_epoch = self.model_epoch
+        eval_tracker: Dict[str, int] = {}
+
+        def stamp_and_feed(episodes, epoch):
+            for ep in episodes:
+                self.num_episodes += 1
+                # in-process generators leave model_id unset (-1): stamp
+                # the epoch whose params played the episode
+                mid = ep['args'].setdefault('model_id', {})
+                for p, v in list(mid.items()):
+                    if v is None or v < 0:
+                        mid[p] = epoch
+            self.feed_episodes(episodes)
 
         while not self.shutdown_flag:
-            actor.params = self.wrapper.params   # follow latest epoch
-            gen_epoch = self.model_epoch         # the params' true epoch
+            if actor_epoch != self.model_epoch:   # follow latest epoch
+                actor.params = jax.device_put(self.wrapper.params)
+                actor_epoch = self.model_epoch
+            dispatch_epoch = self.model_epoch
             if device_ingest:
                 records, done, outcome = gen.step_chunk_records()
-                self.feed_device_chunk(done, outcome, gen_epoch)
+                self.feed_device_chunk(done, outcome, chunk_epoch)
                 self.trainer.seen_episodes = self.num_returned_episodes
                 # BLOCKING hand-off: the windower's per-env histories track
                 # a contiguous ply stream, so dropping a chunk would splice
@@ -863,37 +917,169 @@ class Learner:
                     except queue.Full:
                         continue
             else:
-                episodes = gen.step()
-                for ep in episodes:
-                    self.num_episodes += 1
-                    # in-process generators leave model_id unset (-1): stamp
-                    # the epoch whose params played the episode
-                    mid = ep['args'].setdefault('model_id', {})
-                    for p, v in list(mid.items()):
-                        if v is None or v < 0:
-                            mid[p] = gen_epoch
-                self.feed_episodes(episodes)
+                stamp_and_feed(gen.step(), chunk_epoch)
+            chunk_epoch = dispatch_epoch
 
-            # keep the evaluation share near eval_rate. The host evaluator
-            # advances all its matches ONE ply per call while chunked
-            # generators deliver episodes in bursts, so it gets several
-            # plies per loop iteration or it never finishes a match; the
-            # device evaluator finishes whole batches per call and exits
-            # this loop after one step once the share is met
-            for _ in range(16):
-                if self.num_results >= self.eval_rate * self.num_episodes:
-                    break
-                results = evaluator.step()
-                self.num_results += len(results)
-                self.feed_results(results)
+            self._run_eval_share(evaluator, eval_tracker)
 
-            if self.num_returned_episodes >= next_update_episodes:
-                prev_update_episodes = next_update_episodes
-                next_update_episodes = (prev_update_episodes
-                                        + args['update_episodes'])
+            if cadence.due(self.num_returned_episodes):
                 self.update()
                 if 0 <= self.args['epochs'] <= self.model_epoch:
                     self.shutdown_flag = True
+
+        # account the one speculative chunk still in the pipeline
+        if hasattr(gen, 'drain_records') and device_ingest:
+            tail = gen.drain_records()
+            if tail is not None:
+                _records, done, outcome = tail
+                self.feed_device_chunk(done, outcome, chunk_epoch)
+        elif hasattr(gen, 'drain_episodes'):
+            stamp_and_feed(gen.drain_episodes(), chunk_epoch)
+        if hasattr(evaluator, 'drain'):
+            self.feed_results(evaluator.drain(),
+                              model_id=eval_tracker.get('prev'))
+
+    # -- generation front-end A': the fully-fused device loop --------------
+    def _run_fused(self, env_mod, actor, evaluator, windower, mode):
+        """Single-threaded steady state: ONE program dispatch per loop
+        iteration runs rollout chunk + window ingest + K SGD steps
+        (ops/fused_pipeline.py). The trainer thread stays parked — there is
+        no queue competition on the device stream, and the only per-chunk
+        host traffic is the previous chunk's (done, outcome) fetch.
+
+        Sample reuse is explicit here: ``sgd_steps_per_chunk`` pins the
+        replay ratio instead of letting the trainer thread spin as fast as
+        dispatch latency allows."""
+        args = self.args
+        tr = self.trainer
+        print('fused device pipeline: rollout+ingest+train in one dispatch '
+              '(%s mode)' % mode)
+        from .ops.fused_pipeline import FusedPipeline
+        sgd_steps = int(args.get('sgd_steps_per_chunk') or 16)   # doc: config.py
+        tr.windower = windower   # ring occupancy reporting
+        fp = FusedPipeline(
+            env_mod, actor, tr.cfg, windower, args,
+            n_envs=args.get('generation_envs', 64),
+            chunk_steps=int(args.get('device_chunk_steps') or 16),
+            sgd_steps=sgd_steps, batch_size=args['batch_size'],
+            default_lr=tr.default_lr, seed=args.get('seed', 0))
+
+        cadence = _EpochCadence(args)
+        actor_epoch = self.model_epoch
+        pending_metrics: List[Any] = []
+        epoch_steps = 0
+        epoch_t0 = time.time()
+        eval_tracker: Dict[str, int] = {}
+        # feed_device_chunk is one fetch behind dispatch; chunk -> epoch
+        # attribution therefore uses the epoch captured at dispatch time
+        epoch_of_dispatch = deque()
+
+        def account(prev):
+            if prev is None:
+                return
+            done, outcome = prev
+            self.feed_device_chunk(done, outcome, epoch_of_dispatch.popleft())
+
+        while not self.shutdown_flag:
+            if actor_epoch != self.model_epoch:
+                actor.params = jax.device_put(self.wrapper.params)
+                actor_epoch = self.model_epoch
+            epoch_of_dispatch.append(self.model_epoch)
+            warm = self.num_returned_episodes < args['minimum_episodes']
+            if warm:
+                account(fp.warm_step(actor.params))
+            else:
+                tr.state, prev, metrics = fp.train_step(
+                    actor.params, tr.state, tr.data_cnt_ema)
+                tr.steps += fp.sgd_steps
+                epoch_steps += fp.sgd_steps
+                pending_metrics.append(metrics)
+                account(prev)
+
+            self._run_eval_share(evaluator, eval_tracker)
+
+            if cadence.due(self.num_returned_episodes):
+                self._fused_epoch(pending_metrics, epoch_steps,
+                                  time.time() - epoch_t0, fp, evaluator)
+                pending_metrics = []
+                epoch_steps = 0
+                epoch_t0 = time.time()
+                if 0 <= self.args['epochs'] <= self.model_epoch:
+                    self.shutdown_flag = True
+        account(fp.drain())
+        if hasattr(evaluator, 'drain'):
+            self.feed_results(evaluator.drain(),
+                              model_id=eval_tracker.get('prev'))
+
+    def _fused_epoch(self, pending_metrics, epoch_steps, epoch_wall,
+                     fp, evaluator):
+        """Epoch boundary for the fused loop: drain metric futures, print
+        the reference-format lines, update the lr EMA, checkpoint."""
+        tr = self.trainer
+        print()
+        print('epoch %d' % self.model_epoch)
+        self._print_eval_stats()
+        self._print_generation_stats()
+
+        data_cnt = 0
+        loss_sum: Dict[str, float] = {}
+        for metrics in pending_metrics:
+            for k, v in metrics.items():
+                if k == 'data_count':
+                    data_cnt += int(v)
+                else:
+                    loss_sum[k] = loss_sum.get(k, 0.0) + float(v)
+        if epoch_steps > 0:
+            print('loss = %s' % ' '.join(
+                [k + ':' + '%.3f' % (l / max(data_cnt, 1))
+                 for k, l in sorted(loss_sum.items())]))
+            tr.data_cnt_ema = (tr.data_cnt_ema * 0.8
+                               + data_cnt / (1e-2 + epoch_steps) * 0.2)
+            tr.last_steps_per_sec = epoch_steps / max(epoch_wall, 1e-9)
+        if tr.replay is not None:
+            tr.replay_stats['samples_drawn'] += (
+                epoch_steps * self.args['batch_size'])
+            # window count lives on device; mirror the ring size lazily
+            tr._ring_size_host = int(fp.size)
+            tr.replay_stats['windows_ingested'] = max(
+                tr.replay_stats['windows_ingested'], tr._ring_size_host)
+
+        params = jax.tree_util.tree_map(np.asarray, tr.state.params)
+        self.update_model(params, tr.steps, tr.state_bytes())
+        rec_extra = {'dispatches_gen': fp.dispatches,
+                     'dispatches_eval': getattr(evaluator, 'dispatches', 0)}
+        self._write_metrics(tr.steps, rec_extra)
+        self.flags = set()
+
+    def _print_eval_stats(self):
+        if self.model_epoch not in self.results:
+            print('win rate = Nan (0)')
+            return
+
+        def output_wp(name, results):
+            n, r, r2 = results
+            mean = r / (n + 1e-6)
+            name_tag = ' (%s)' % name if name != '' else ''
+            print('win rate%s = %.3f (%.1f / %d)'
+                  % (name_tag, (mean + 1) / 2, (r + n) / 2, n))
+
+        keys = self.results_per_opponent[self.model_epoch]
+        if (len(self.args.get('eval', {}).get('opponent', [])) <= 1
+                and len(keys) <= 1):
+            output_wp('', self.results[self.model_epoch])
+        else:
+            output_wp('total', self.results[self.model_epoch])
+            for key in sorted(keys):
+                output_wp(key, keys[key])
+
+    def _print_generation_stats(self):
+        if self.model_epoch not in self.generation_results:
+            print('generation stats = Nan (0)')
+            return
+        n, r, r2 = self.generation_results[self.model_epoch]
+        mean = r / (n + 1e-6)
+        std = (r2 / (n + 1e-6) - mean ** 2) ** 0.5
+        print('generation stats = %.3f +- %.3f' % (mean, std))
 
     # -- generation front-end B: RPC server over workers ------------------
     def server(self):
@@ -901,8 +1087,7 @@ class Learner:
         (reference train.py:541-627; 'model' answers with an architecture
         name + msgpack params snapshot, never pickled code)."""
         print('started server')
-        prev_update_episodes = self.args['minimum_episodes']
-        next_update_episodes = prev_update_episodes + self.args['update_episodes']
+        cadence = _EpochCadence(self.args)
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
             try:
@@ -969,10 +1154,7 @@ class Learner:
                 send_data = send_data[0]
             self.worker.send(conn, send_data)
 
-            if self.num_returned_episodes >= next_update_episodes:
-                prev_update_episodes = next_update_episodes
-                next_update_episodes = (prev_update_episodes
-                                        + self.args['update_episodes'])
+            if cadence.due(self.num_returned_episodes):
                 self.update()
                 if 0 <= self.args['epochs'] <= self.model_epoch:
                     self.shutdown_flag = True

@@ -20,6 +20,7 @@ def test_tictactoe_device_ingest_learner(tmp_path, capsys):
             'update_episodes': 40, 'minimum_episodes': 40, 'epochs': 2,
             'generation_envs': 16, 'num_batchers': 1,
             'device_generation': True, 'device_replay': True,
+            'fused_pipeline': False,   # the split threaded path under test
             'model_dir': str(tmp_path / 'models'),
         },
     }
@@ -46,6 +47,7 @@ def test_geese_device_ingest_learner(tmp_path, capsys):
             'batch_size': 12, 'update_episodes': 10, 'minimum_episodes': 10,
             'epochs': 1, 'generation_envs': 8, 'num_batchers': 1,
             'device_generation': True, 'device_replay': True,
+            'fused_pipeline': False,   # the split threaded path under test
             'policy_target': 'VTRACE', 'value_target': 'VTRACE',
             'model_dir': str(tmp_path / 'models'),
         },

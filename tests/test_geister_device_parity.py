@@ -1,0 +1,153 @@
+"""Pin the observation=True semantics for turn-based device rollouts (the
+geister-device config) to the reference's.
+
+The reference generator runs inference only for ``turn_players + observers``
+(reference generation.py:37-41) and NO reference env overrides
+``observers()`` (defaults to [], reference environment.py:84); the eval-side
+Agent advances hidden only on its own turns (reference evaluation.py:97-101).
+So observation=True does NOT mean "everyone observes every ply" — it only
+widens the batch layout to the full player axis (reference train.py:65-68)
+with observation_mask marking the acting seat. These tests assert the device
+engine records exactly that, and that a device-generated Geister episode is
+batch-level indistinguishable from a host-generated one."""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.device_generation import DeviceEvaluator, DeviceGenerator
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.envs import jax_geister as jgs
+from handyrl_tpu.generation import Generator
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.models.geister import GeisterNet
+from handyrl_tpu.ops.batch import decompress_moments, make_batch, select_episode
+
+
+def _obs_args():
+    return {
+        'turn_based_training': True, 'observation': True,
+        'gamma': 0.9, 'forward_steps': 8, 'burn_in_steps': 2,
+        'compress_steps': 4, 'maximum_episodes': 100,
+        'lambda': 0.7, 'policy_target': 'TD', 'value_target': 'TD',
+        'entropy_regularization': 0.1, 'entropy_regularization_decay': 0.1,
+    }
+
+
+def _wrapper():
+    env = make_env({'env': 'Geister'})
+    env.reset()
+    w = ModelWrapper(GeisterNet(filters=8, drc_layers=2, drc_repeats=1))
+    w.ensure_params(env.observation(0))
+    return w
+
+
+@pytest.fixture(scope='module')
+def episode_pair():
+    """(wrapper, device episodes, one host episode) under the same config."""
+    wrapper = _wrapper()
+    args = _obs_args()
+    gen = DeviceGenerator(jgs, wrapper, args, n_envs=4, chunk_steps=16)
+    dev_episodes = []
+    for _ in range(30):
+        dev_episodes += gen.step_chunk()
+        if len(dev_episodes) >= 2:
+            break
+    assert len(dev_episodes) >= 2, 'device generator produced no episodes'
+
+    env = make_env({'env': 'Geister'})
+    host_gen = Generator(env, args)
+    models = {p: wrapper for p in (0, 1)}
+    host_ep = None
+    for _ in range(5):
+        host_ep = host_gen.generate(models, {
+            'role': 'g', 'player': [0, 1], 'model_id': {0: -1, 1: -1}})
+        if host_ep is not None:
+            break
+    assert host_ep is not None
+    return wrapper, dev_episodes, host_ep
+
+
+def _assert_acting_seat_only(moments):
+    for m in moments:
+        player = m['turn'][0]
+        other = 1 - player
+        # exactly the acting seat observed, acted, and has a value estimate
+        assert m['observation'][player] is not None
+        assert m['value'][player] is not None
+        assert m['action'][player] is not None
+        assert m['selected_prob'][player] is not None
+        assert m['action_mask'][player] is not None
+        assert m['observation'][other] is None
+        assert m['value'][other] is None
+        assert m['action'][other] is None
+        assert m['selected_prob'][other] is None
+        assert m['action_mask'][other] is None
+
+
+def test_device_moments_match_reference_semantics(episode_pair):
+    _, dev_episodes, host_ep = episode_pair
+    for ep in dev_episodes[:2]:
+        moments = decompress_moments(ep['moment'])
+        assert len(moments) == ep['steps']
+        _assert_acting_seat_only(moments)
+    _assert_acting_seat_only(decompress_moments(host_ep['moment']))
+
+
+def test_device_batch_matches_host_batch(episode_pair):
+    """Batch-level parity through ops/batch.py: same leaf set, same shapes
+    (modulo batch size), same mask semantics — observation_mask is the
+    acting-seat one-hot (== turn_mask), padded windows honor the same pad
+    values."""
+    _, dev_episodes, host_ep = episode_pair
+    args = _obs_args()
+
+    def invariants(batch):
+        emask = np.asarray(batch['episode_mask'])      # (B, T, 1, 1)
+        omask = np.asarray(batch['observation_mask'])  # (B, T, 2, 1)
+        tmask = np.asarray(batch['turn_mask'])
+        assert omask.shape[2] == 2
+        # observers() is empty for Geister: who observed == who acted
+        np.testing.assert_array_equal(omask, tmask)
+        # exactly one acting seat per in-episode step
+        np.testing.assert_array_equal(
+            tmask.sum(axis=2)[..., 0], emask[:, :, 0, 0])
+        # non-observers' observations are zero
+        board = np.asarray(batch['observation']['board'])  # (B,T,2,7,6,6)
+        dead = (omask[..., None, None] == 0)
+        assert np.abs(board * dead[:, :, :board.shape[2]]).max() == 0
+        # selected_prob pads/non-actors are 1 (log prob 0)
+        prob = np.asarray(batch['selected_prob'])
+        np.testing.assert_array_equal(prob[np.asarray(tmask) == 0], 1.0)
+
+    dev_batch = make_batch(
+        [select_episode(dev_episodes, args) for _ in range(4)], args)
+    host_batch = make_batch(
+        [select_episode([host_ep], args) for _ in range(2)], args)
+    invariants(dev_batch)
+    invariants(host_batch)
+    assert set(dev_batch.keys()) == set(host_batch.keys())
+    for k in dev_batch:
+        if k == 'observation':
+            for leaf in dev_batch[k]:
+                assert dev_batch[k][leaf].shape[1:] == host_batch[k][leaf].shape[1:]
+        else:
+            assert dev_batch[k].shape[1:] == host_batch[k].shape[1:], k
+
+
+def test_device_evaluator_hidden_advances_only_on_own_turns():
+    """Reference eval parity: the Agent's hidden advances only when it acts
+    (observers() is empty), so the device evaluator's acting-seat hidden
+    gather/scatter is exactly right — and matches should complete."""
+    wrapper = _wrapper()
+    ev = DeviceEvaluator(jgs, wrapper, _obs_args(), n_envs=4, chunk_steps=16)
+    results = []
+    for _ in range(30):
+        results += ev.step()
+        if results:
+            break
+    assert results, 'device evaluator finished no matches'
+    for res in results[:5]:
+        seat = res['args']['player'][0]
+        assert res['opponent'] == 'random'
+        assert set(res['result'].keys()) == {0, 1}
+        assert res['result'][seat] in (-1.0, 0.0, 1.0)
