@@ -98,6 +98,36 @@ def _reset_hidden_where_done(hidden, done):
                             jnp.zeros_like(h), h), hidden)
 
 
+class _RecordPacker:
+    """Flatten a records pytree into ONE f32 device array and back.
+
+    On a tunneled TPU each distinct array fetch pays a full host round trip
+    (~140 ms measured) while bandwidth is cheap, so the splice path packs
+    every record leaf into a single transfer instead of one per leaf. The
+    pack runs as its own tiny jitted program (async dispatch, ~4 ms);
+    unpack restores shapes/dtypes exactly (int/bool values are small enough
+    to round-trip through f32 losslessly)."""
+
+    def __init__(self, records):
+        leaves, self.treedef = jax.tree_util.tree_flatten(records)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self._fn = jax.jit(lambda ls: jnp.concatenate(
+            [l.astype(jnp.float32).reshape(-1) for l in ls]))
+
+    def pack(self, records):
+        return self._fn(jax.tree_util.tree_leaves(records))
+
+    def unpack(self, flat):
+        flat = np.asarray(flat)   # the one transfer
+        out, pos = [], 0
+        for shape, dtype in zip(self.shapes, self.dtypes):
+            n = int(np.prod(shape)) if shape else 1
+            out.append(flat[pos:pos + n].reshape(shape).astype(dtype))
+            pos += n
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+
 # NOTE on observation=True for turn-based envs (the geister-device config):
 # the reference generator runs inference ONLY for ``turn_players +
 # observers`` each ply (reference generation.py:37-41), and no reference env
@@ -205,6 +235,8 @@ class DeviceGenerator:
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         self._partials: List[List[dict]] = [[] for _ in range(n_envs)]
         self._pending = None
+        self._acct_pack = None
+        self._full_pack = None
         self.dispatches = 0
 
         rollout_chunk = make_gen_body(env_mod, wrapper.module.apply,
@@ -222,48 +254,63 @@ class DeviceGenerator:
         self.dispatches += 1
         return dict(records)
 
+    def _dispatch_acct(self):
+        """Dispatch rollout + the tiny done/outcome pack (one fetchable)."""
+        records = self._dispatch()
+        if self._acct_pack is None:
+            self._acct_pack = _RecordPacker(
+                {'done': records['done'], 'outcome': records['outcome']})
+        return records, self._acct_pack.pack(
+            {'done': records['done'], 'outcome': records['outcome']})
+
     def step_chunk_records(self):
         """Run one compiled chunk, keeping the trajectory ON DEVICE.
 
         For the device-ingest pipeline (ops/device_windows.py): returns the
         raw records pytree (device arrays, leading axes (K, N)) plus host
-        copies of ONLY the tiny done/outcome arrays for episode accounting.
+        copies of ONLY the tiny done/outcome arrays for episode accounting,
+        fetched as ONE packed array (a fetch costs a tunnel round trip).
         The heavy leaves (observations, masks) never reach the host.
         """
         if self._pending is None:
-            self._pending = self._dispatch()
-        records, self._pending = self._pending, self._dispatch()
-        done = np.asarray(records['done'])
-        outcome = np.asarray(records['outcome'])
-        return records, done, outcome
+            self._pending = self._dispatch_acct()
+        (records, pack), self._pending = self._pending, self._dispatch_acct()
+        acct = self._acct_pack.unpack(pack)
+        return records, acct['done'], acct['outcome']
 
     def drain_records(self):
         """Fetch the in-flight speculative chunk at loop shutdown (device-
         ingest mode); returns (records, done, outcome) or None."""
         if self._pending is None:
             return None
-        records, self._pending = self._pending, None
-        return records, np.asarray(records['done']), \
-            np.asarray(records['outcome'])
+        (records, pack), self._pending = self._pending, None
+        acct = self._acct_pack.unpack(pack)
+        return records, acct['done'], acct['outcome']
 
     # -- host-side episode splicing ---------------------------------------
+    def _dispatch_full(self):
+        """Dispatch rollout + the full-record pack (splice mode fetches
+        EVERY leaf; packed, that is one transfer instead of one per leaf)."""
+        records = self._dispatch()
+        if self._full_pack is None:
+            self._full_pack = _RecordPacker(records)
+        return self._full_pack.pack(records)
+
     def step_chunk(self) -> List[dict]:
         """Run one compiled chunk; return episodes completed within it."""
         if self._pending is None:
-            self._pending = self._dispatch()
-        records, self._pending = self._pending, self._dispatch()
-        return self._splice(records)
+            self._pending = self._dispatch_full()
+        pack, self._pending = self._pending, self._dispatch_full()
+        return self._splice(self._full_pack.unpack(pack))
 
     def drain_episodes(self) -> List[dict]:
         """Splice the in-flight speculative chunk at loop shutdown."""
         if self._pending is None:
             return []
-        records, self._pending = self._pending, None
-        return self._splice(records)
+        pack, self._pending = self._pending, None
+        return self._splice(self._full_pack.unpack(pack))
 
-    def _splice(self, records) -> List[dict]:
-        rec = map_structure(lambda v: None if v is None else np.asarray(v),
-                            records)
+    def _splice(self, rec) -> List[dict]:
         players = list(range(self.env_mod.NUM_PLAYERS))
         episodes: List[dict] = []
         for k in range(self.chunk_steps):
@@ -357,6 +404,7 @@ class DeviceEvaluator:
         # (and every goose slot) are balanced like evaluate_mp's scheduler
         self.seat = jnp.arange(n_envs, dtype=jnp.int32) % env_mod.NUM_PLAYERS
         self._pending = None
+        self._pack = None
         self.dispatches = 0
 
         apply_fn = wrapper.module.apply
@@ -401,33 +449,36 @@ class DeviceEvaluator:
     pipelined = True
 
     def _dispatch(self):
+        """Dispatch a chunk + its packed (done, seat, outcome) fetchable."""
         self.state, self.hidden, self.seat, self.rng, records = \
             self._rollout(self.wrapper.params, self.state, self.hidden,
                           self.seat, self.rng)
         self.dispatches += 1
-        return dict(records)
+        records = dict(records)
+        if self._pack is None:
+            self._pack = _RecordPacker(records)
+        return self._pack.pack(records)
 
     def step(self) -> List[dict]:
         """One compiled chunk; returns finished eval result records (the
         same shape Learner.feed_results consumes from BatchedEvaluator).
         Pipelined one chunk deep like DeviceGenerator: the next chunk is
-        enqueued before the previous one's outcome arrays are fetched."""
+        enqueued before the previous one's outcome arrays are fetched (as
+        ONE packed array — a fetch costs a tunnel round trip)."""
         if self._pending is None:
             self._pending = self._dispatch()
-        records, self._pending = self._pending, self._dispatch()
-        return self._collect(records)
+        pack, self._pending = self._pending, self._dispatch()
+        return self._collect(self._pack.unpack(pack))
 
     def drain(self) -> List[dict]:
         """Collect the in-flight speculative chunk at loop shutdown."""
         if self._pending is None:
             return []
-        records, self._pending = self._pending, None
-        return self._collect(records)
+        pack, self._pending = self._pending, None
+        return self._collect(self._pack.unpack(pack))
 
-    def _collect(self, records) -> List[dict]:
-        done = np.asarray(records['done'])
-        seats = np.asarray(records['seat'])
-        outcomes = np.asarray(records['outcome'])
+    def _collect(self, rec) -> List[dict]:
+        done, seats, outcomes = rec['done'], rec['seat'], rec['outcome']
         players = list(range(self.env_mod.NUM_PLAYERS))
         results: List[dict] = []
         for k, i in zip(*np.nonzero(done)):

@@ -34,6 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _take(hist_leaf, idxm):
@@ -196,6 +197,7 @@ class DeviceWindower:
         self.P = num_players
         self.gamma = gamma
         self.has_reward = has_reward
+        self.window_spec: Optional[Dict[str, Tuple]] = None  # set by init_ring
         self._ingest = None   # jitted lazily once ring shapes exist
 
     # -- state/ring allocation --------------------------------------------
@@ -218,23 +220,49 @@ class DeviceWindower:
         return keys
 
     def init_ring(self, records) -> Dict[str, Any]:
-        """Zero ring buffers: run the window builder on dummies for shapes."""
-        state = self.init_state(records)
-        hist1 = jax.tree_util.tree_map(lambda h: h[0], state['hist'])
+        """Zero ring buffers, shaped via eval_shape — NOTHING runs on
+        device here. (Running the window builder eagerly op-by-op cost ~26 s
+        through the TPU tunnel: every un-jitted op is its own compile +
+        dispatch.)
+
+        Ring storage is FLATTENED per window: leaf (capacity, prod(shape)).
+        TPU tiled layouts pad the two minormost dims to (8, 128); storing
+        windows in natural (T, P, ...) shape put tiny trailing dims (e.g.
+        Hungry Geese's 7x11 board) in the tile, inflating a 4 GB ring to a
+        31 GB allocation. 2-D storage pads ~1%; consumers reshape after
+        gather via ``window_spec``."""
+        def spec_of(key):
+            leaf = records[key]
+            return jax.ShapeDtypeStruct((self.L,) + tuple(leaf.shape[2:]),
+                                        leaf.dtype)
+
+        hist1 = {k: spec_of(k) for k in self._hist_keys()}
         if self.has_reward:
-            hist1 = dict(hist1)
-            hist1['return'] = jnp.zeros_like(hist1['reward'])
-        outcome1 = jnp.zeros((self.P,), jnp.float32)
-        ts = jnp.zeros((1,), jnp.int32)
+            hist1['return'] = hist1['reward']
+        outcome1 = jax.ShapeDtypeStruct((self.P,), jnp.float32)
+        ts = jax.ShapeDtypeStruct((1,), jnp.int32)
+        s_one = jax.ShapeDtypeStruct((), jnp.int32)
         if self.mode == 'solo':
-            win = build_windows_solo(hist1, jnp.int32(1), ts,
-                                     jnp.zeros((1,), jnp.int32), outcome1,
-                                     self.fs, self.bi, self.L)
+            win = jax.eval_shape(
+                lambda h, s, t, seat, oc: build_windows_solo(
+                    h, s, t, seat, oc, self.fs, self.bi, self.L),
+                hist1, s_one, ts, ts, outcome1)
         else:
-            win = build_windows_turn(hist1, jnp.int32(1), ts, outcome1,
-                                     self.fs, self.bi, self.L, self.P)
-        return jax.tree_util.tree_map(
-            lambda w: jnp.zeros((self.capacity,) + w.shape[1:], w.dtype), win)
+            win = jax.eval_shape(
+                lambda h, s, t, oc: build_windows_turn(
+                    h, s, t, oc, self.fs, self.bi, self.L, self.P),
+                hist1, s_one, ts, outcome1)
+        self.window_spec = {k: (tuple(w.shape[1:]), w.dtype)
+                            for k, w in win.items()}
+        return {k: jnp.zeros(
+                    (self.capacity, int(np.prod(shape)) if shape else 1),
+                    dtype)
+                for k, (shape, dtype) in self.window_spec.items()}
+
+    def unflatten_rows(self, rows: Dict[str, Any]) -> Dict[str, Any]:
+        """(n, flat) ring rows -> (n,) + window shape, per leaf."""
+        return {k: v.reshape((v.shape[0],) + self.window_spec[k][0])
+                for k, v in rows.items()}
 
     # -- the ingest program ------------------------------------------------
     def ingest(self, records, state, ring, cursor, size, rng):
@@ -312,8 +340,11 @@ class DeviceWindower:
                 flat_slot = slot.reshape(-1)
 
                 def scatter(rb, wb):
+                    # ring rows are flat (see init_ring): (N, W, ...) ->
+                    # (N*W, prod(window shape))
                     return rb.at[flat_slot].set(
-                        wb.reshape((-1,) + wb.shape[2:]), mode='drop')
+                        wb.reshape((wb.shape[0] * wb.shape[1], -1)),
+                        mode='drop')
 
                 return (jax.tree_util.tree_map(scatter, ring, windows),
                         jnp.sum(dcount))

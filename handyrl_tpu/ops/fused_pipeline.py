@@ -74,6 +74,9 @@ class FusedPipeline:
         self.cursor = jnp.zeros((), jnp.int32)
         self.size = jnp.zeros((), jnp.int32)
 
+        self.num_players = int(env_mod.NUM_PLAYERS)
+        self._metric_keys: list = []   # filled at trace time, static order
+
         def gen_ingest(actor_params, env_state, hidden, wstate, ring,
                        cursor, size, rng):
             env_state, hidden, rng, records = rollout_chunk(
@@ -81,12 +84,31 @@ class FusedPipeline:
             (wstate, ring, cursor, size, rng,
              n_done, n_win) = ingest(records, wstate, ring, cursor, size, rng)
             return (env_state, hidden, wstate, ring, cursor, size, rng,
-                    records['done'], records['outcome'], n_win)
+                    records['done'], records['outcome'])
+
+        def pack(done, outcome, size, metric_vals):
+            # EVERYTHING the host reads per chunk rides ONE f32 array: a
+            # distinct-array fetch costs a full tunnel round trip (~140 ms
+            # measured), so one sync point per dispatch is the budget
+            parts = [done.astype(jnp.float32).reshape(-1),
+                     outcome.astype(jnp.float32).reshape(-1),
+                     size.astype(jnp.float32).reshape(1)]
+            parts += [v.astype(jnp.float32).reshape(1) for v in metric_vals]
+            return jnp.concatenate(parts)
+
+        def warmup(actor_params, env_state, hidden, wstate, ring,
+                   cursor, size, rng):
+            (env_state, hidden, wstate, ring, cursor, size, rng,
+             done, outcome) = gen_ingest(
+                actor_params, env_state, hidden, wstate, ring, cursor,
+                size, rng)
+            return (env_state, hidden, wstate, ring, cursor, size, rng,
+                    pack(done, outcome, size, []))
 
         def fused(actor_params, train_state: TrainState, env_state, hidden,
                   wstate, ring, cursor, size, rng, data_cnt_ema):
             (env_state, hidden, wstate, ring, cursor, size, rng,
-             done, outcome, n_win) = gen_ingest(
+             done, outcome) = gen_ingest(
                 actor_params, env_state, hidden, wstate, ring, cursor,
                 size, rng)
 
@@ -95,7 +117,11 @@ class FusedPipeline:
                 key, sub = jax.random.split(key)
                 slots = recency_slots(sub, size, cursor, capacity,
                                       batch_size)
-                batch = jax.tree_util.tree_map(lambda b: b[slots], ring)
+                # ring rows are stored flat (device_windows.init_ring);
+                # restore the (B, T, P, ...) window shape after the gather
+                batch = {k: ring[k][slots].reshape(
+                            (batch_size,) + windower.window_spec[k][0])
+                         for k in ring}
                 lr = (default_lr * data_cnt_ema
                       / (1 + ts.steps.astype(jnp.float32) * 1e-5))
                 ts, metrics = update(ts, batch, lr)
@@ -105,50 +131,67 @@ class FusedPipeline:
                 body, (train_state, rng), None, length=sgd_steps)
             metrics = jax.tree_util.tree_map(
                 lambda m: jnp.sum(m, axis=0), stacked)
+            keys = sorted(metrics)         # static: recorded at trace time
+            self._metric_keys[:] = keys
             return (train_state, env_state, hidden, wstate, ring, cursor,
-                    size, rng, done, outcome, n_win, metrics)
+                    size, rng,
+                    pack(done, outcome, size, [metrics[k] for k in keys]))
 
         # donate everything the pipeline owns plus the train state; actor
         # params and the EMA scalar are plain (re-used) inputs
-        self._warmup = jax.jit(gen_ingest,
+        self._warmup = jax.jit(warmup,
                                donate_argnums=(1, 2, 3, 4, 5, 6, 7))
         self._fused = jax.jit(fused,
                               donate_argnums=tuple(range(1, 10)))
-        self._pending = None   # (done, outcome) device arrays, one deep
+        self._pending = None   # (pack_future, has_metrics), one deep
+        self.ring_size_host = 0
 
     # -- dispatch helpers --------------------------------------------------
-    def _flip(self, done, outcome):
-        """Pipeline the tiny per-chunk fetch one dispatch deep."""
-        prev, self._pending = self._pending, (done, outcome)
+    def _parse(self, pending):
+        flat, has_metrics = pending
+        flat = np.asarray(flat)
+        K, N, P = self.chunk_steps, self.n_envs, self.num_players
+        done = flat[:K * N].reshape(K, N) > 0.5
+        outcome = flat[K * N:K * N * (1 + P)].reshape(K, N, P)
+        rest = flat[K * N * (1 + P):]
+        self.ring_size_host = int(rest[0])
+        metrics = None
+        if has_metrics:
+            metrics = {k: float(v)
+                       for k, v in zip(self._metric_keys, rest[1:])}
+        return {'done': done, 'outcome': outcome, 'metrics': metrics}
+
+    def _flip(self, pack_future, has_metrics):
+        """Pipeline the single per-chunk fetch one dispatch deep."""
+        prev, self._pending = self._pending, (pack_future, has_metrics)
         self.dispatches += 1
         if prev is None:
             return None
-        return np.asarray(prev[0]), np.asarray(prev[1])
+        return self._parse(prev)
 
     def warm_step(self, actor_params):
-        """Generation+ingest only (pre-minimum_episodes). Returns host
-        (done, outcome) of the PREVIOUS chunk, or None on the first call."""
+        """Generation+ingest only (pre-minimum_episodes). Returns the parsed
+        accounting of the PREVIOUS chunk, or None on the first call."""
         (self.state, self.hidden, self.wstate, self.ring, self.cursor,
-         self.size, self.rng, done, outcome, _n_win) = self._warmup(
+         self.size, self.rng, packed) = self._warmup(
             actor_params, self.state, self.hidden, self.wstate, self.ring,
             self.cursor, self.size, self.rng)
-        return self._flip(done, outcome)
+        return self._flip(packed, False)
 
     def train_step(self, actor_params, train_state: TrainState,
                    data_cnt_ema: float):
         """One fused chunk+ingest+K-SGD-steps dispatch. Returns
-        (train_state, prev_done_outcome_or_None, metrics_future)."""
+        (train_state, parsed_prev_chunk_or_None)."""
         (train_state, self.state, self.hidden, self.wstate, self.ring,
-         self.cursor, self.size, self.rng, done, outcome, _n_win,
-         metrics) = self._fused(
+         self.cursor, self.size, self.rng, packed) = self._fused(
             actor_params, train_state, self.state, self.hidden, self.wstate,
             self.ring, self.cursor, self.size, self.rng,
             jnp.asarray(data_cnt_ema, jnp.float32))
-        return train_state, self._flip(done, outcome), metrics
+        return train_state, self._flip(packed, True)
 
     def drain(self):
         """Fetch the last in-flight chunk's accounting (loop shutdown)."""
         if self._pending is None:
             return None
         prev, self._pending = self._pending, None
-        return np.asarray(prev[0]), np.asarray(prev[1])
+        return self._parse(prev)

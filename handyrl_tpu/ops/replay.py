@@ -19,10 +19,11 @@ update step consumes.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def recency_slots(key, size, cursor, capacity: int, batch_size: int):
@@ -56,7 +57,14 @@ class DeviceReplay:
 
     def __init__(self, capacity: int, mesh=None):
         self.capacity = capacity
-        self.buffers: Dict[str, Any] = {}
+        # storage is a LIST of 2-D (capacity, prod(window shape)) buffers —
+        # TPU tiled layouts pad the two minormost dims to (8, 128), so
+        # natural (T, P, ...) storage with tiny trailing dims inflates HBM
+        # by an order of magnitude; window_spec + treedef restore the
+        # original pytree after sampling
+        self.buffers: List[Any] = []
+        self.window_spec: List[tuple] = []   # per-leaf (shape, dtype)
+        self.treedef = None
         self.cursor = 0
         self.size = 0
         self.mesh = mesh
@@ -65,14 +73,11 @@ class DeviceReplay:
             from ..parallel.mesh import replicated_sharding
             self._repl = replicated_sharding(mesh)
 
-        def _write(buffers, windows, cursor):
-            n = jax.tree_util.tree_leaves(windows)[0].shape[0]
+        def _write(buffers, leaves, cursor):
+            n = leaves[0].shape[0]
             idx = (cursor + jnp.arange(n)) % self.capacity
-
-            def put(buf, win):
-                return buf.at[idx].set(win)
-
-            return jax.tree_util.tree_map(put, buffers, windows)
+            return [buf.at[idx].set(leaf.reshape(leaf.shape[0], -1))
+                    for buf, leaf in zip(buffers, leaves)]
 
         if mesh is None:
             _write = jax.jit(_write)
@@ -82,19 +87,26 @@ class DeviceReplay:
         @partial(jax.jit, static_argnames=('batch_size',))
         def _sample(buffers, key, size, cursor, batch_size):
             slots = recency_slots(key, size, cursor, capacity, batch_size)
-            return jax.tree_util.tree_map(lambda b: b[slots], buffers)
+            rows = [b[slots].reshape((batch_size,) + shape)
+                    for b, (shape, _) in zip(buffers, self.window_spec)]
+            return jax.tree_util.tree_unflatten(self.treedef, rows)
 
         self._write_fn = _write
         self._sample_fn = _sample
 
     def push(self, windows: Dict[str, Any]):
         """Append a stack of windows (leading axis = window count)."""
-        n = jax.tree_util.tree_leaves(windows)[0].shape[0]
+        leaves, treedef = jax.tree_util.tree_flatten(windows)
+        n = leaves[0].shape[0]
         if not self.buffers:
-            def alloc(win):
-                return jnp.zeros((self.capacity,) + win.shape[1:], win.dtype)
-            self.buffers = jax.tree_util.tree_map(alloc, windows)
-        self.buffers = self._write_fn(self.buffers, windows,
+            self.treedef = treedef
+            self.window_spec = [(tuple(l.shape[1:]), l.dtype)
+                                for l in leaves]
+            self.buffers = [
+                jnp.zeros((self.capacity,
+                           max(1, int(np.prod(l.shape[1:])))), l.dtype)
+                for l in leaves]
+        self.buffers = self._write_fn(self.buffers, leaves,
                                       jnp.asarray(self.cursor, jnp.int32))
         self.cursor = (self.cursor + n) % self.capacity
         self.size = min(self.size + n, self.capacity)

@@ -102,7 +102,8 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
 
 def build_replay_update(module, cfg: LossConfig, capacity: int,
                         batch_size: int, num_steps: int,
-                        default_lr: float = 3e-8, mesh=None):
+                        default_lr: float = 3e-8, mesh=None,
+                        spec_fn=None):
     """Fused replay-mode trainer: K SGD steps in ONE compiled program.
 
     The per-step host round trip (sample dispatch + update dispatch + PRNG
@@ -128,12 +129,28 @@ def build_replay_update(module, cfg: LossConfig, capacity: int,
     update = _update_core(module, cfg, make_optimizer())
     data = batch_sharding(mesh) if mesh is not None else None
 
+    def gather(buffers, slots):
+        """Ring rows are stored FLAT (capacity, prod(window shape)) to
+        avoid TPU tile-padding blowup (ops/replay.py); ``spec_fn`` supplies
+        the per-leaf window shapes at trace time. Two storage flavors:
+        DeviceReplay's (leaf list + treedef) and DeviceWindower's ring
+        (flat dict keyed like the batch)."""
+        if spec_fn is None:
+            return jax.tree_util.tree_map(lambda b: b[slots], buffers)
+        spec, treedef = spec_fn()
+        if isinstance(buffers, dict):
+            return {k: buffers[k][slots].reshape(
+                        (batch_size,) + spec[k][0]) for k in buffers}
+        rows = [b[slots].reshape((batch_size,) + shape)
+                for b, (shape, _) in zip(buffers, spec)]
+        return jax.tree_util.tree_unflatten(treedef, rows)
+
     def fused(state: TrainState, buffers, key, size, cursor, data_cnt_ema):
         def body(carry, _):
             state, key = carry
             key, sub = jax.random.split(key)
             slots = recency_slots(sub, size, cursor, capacity, batch_size)
-            batch = jax.tree_util.tree_map(lambda b: b[slots], buffers)
+            batch = gather(buffers, slots)
             if data is not None:
                 batch = jax.lax.with_sharding_constraint(
                     batch, jax.tree_util.tree_map(lambda _: data, batch))

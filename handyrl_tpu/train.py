@@ -51,6 +51,7 @@ from .ops.batch import make_batch, select_episode
 from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
+from .utils.fetch import put_tree
 from .worker import WorkerCluster, WorkerServer
 
 
@@ -204,7 +205,14 @@ class Trainer:
             self.replay_update = build_replay_update(
                 wrapper.module, self.cfg, capacity=self.replay.capacity,
                 batch_size=args['batch_size'], num_steps=self.fused_steps,
-                default_lr=self.default_lr, mesh=self.mesh)
+                default_lr=self.default_lr, mesh=self.mesh,
+                # window shapes resolved at trace time (first update): by
+                # then either the windower ring (device ingest) or the
+                # DeviceReplay (host push) has seen its first windows
+                spec_fn=lambda: (
+                    (self.windower.window_spec, None)
+                    if getattr(self, 'windower', None) is not None
+                    else (self.replay.window_spec, self.replay.treedef)))
             # observability: audited by metrics JSONL (replay_* fields)
             self.replay_stats = {'dropped_episodes': 0,
                                  'windows_ingested': 0,
@@ -243,9 +251,14 @@ class Trainer:
     # The reference checkpoints the model only (optimizer state and RNG are
     # lost on resume, docs/parameters.md:76-82); here the whole TrainState
     # round-trips so restarts continue the same optimization trajectory.
-    def state_bytes(self) -> bytes:
+    def state_bytes(self, host_state: Optional[TrainState] = None) -> bytes:
         from flax import serialization
-        payload = {'state': self.state, 'steps': self.steps,
+        from .utils.fetch import fetch_tree
+        # fetch the whole state in one packed transfer first: serialization
+        # walks leaves with np.asarray, which on a tunneled TPU would pay a
+        # round trip per leaf
+        state = host_state if host_state is not None else fetch_tree(self.state)
+        payload = {'state': state, 'steps': self.steps,
                    'data_cnt_ema': self.data_cnt_ema}
         return serialization.to_bytes(payload)
 
@@ -324,9 +337,7 @@ class Trainer:
                 batch_cnt += self.fused_steps
                 self.steps += self.fused_steps
                 if len(pending_metrics) >= 4:
-                    data_cnt += int(sum(float(m['data_count'])
-                                        for m in pending_metrics))
-                    self._drain_metrics(pending_metrics)
+                    data_cnt += self._drain_metrics(pending_metrics)
                     pending_metrics = []
                 if 0 <= profile_stop_at <= self.steps:
                     jax.block_until_ready(metrics['total'])
@@ -347,8 +358,7 @@ class Trainer:
             # data_count is a device scalar; fetch lazily every few steps to
             # avoid a sync per update
             if len(pending_metrics) >= 8:
-                data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
-                self._drain_metrics(pending_metrics)
+                data_cnt += self._drain_metrics(pending_metrics)
                 pending_metrics = []
             self.steps += 1
             if self.steps == profile_stop_at:
@@ -357,8 +367,7 @@ class Trainer:
                 print('profiler trace written to %s' % self._profile_dir)
 
         if pending_metrics:
-            data_cnt += int(sum(float(m['data_count']) for m in pending_metrics))
-            self._drain_metrics(pending_metrics)
+            data_cnt += self._drain_metrics(pending_metrics)
 
         if batch_cnt > 0:   # zero only when interrupted by shutdown
             loss_sum = self._loss_sum
@@ -369,7 +378,8 @@ class Trainer:
             self.data_cnt_ema = (self.data_cnt_ema * 0.8
                                  + data_cnt / (1e-2 + batch_cnt) * 0.2)
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
-        return jax.tree_util.tree_map(np.asarray, self.state.params)
+        from .utils.fetch import fetch_tree
+        return fetch_tree(self.state.params)
 
     def _ingest_device_chunks(self):
         """Drain rollout-record chunks (device arrays) into the HBM ring via
@@ -449,12 +459,19 @@ class Trainer:
             self.replay.push(stack_windows(chunk))
             self.replay_stats['windows_ingested'] += self.PUSH_CHUNK
 
-    def _drain_metrics(self, pending: List[Dict[str, Any]]):
-        for m in pending:
+    def _drain_metrics(self, pending: List[Dict[str, Any]]) -> int:
+        """Fetch queued metric dicts in ONE packed transfer (per-scalar
+        float() costs a tunnel round trip each) and fold them into the
+        epoch's loss sums. Returns the summed data_count."""
+        from .utils.fetch import fetch_tree
+        data_cnt = 0
+        for m in fetch_tree(pending):
             for k, v in m.items():
                 if k == 'data_count':
-                    continue
-                self._loss_sum[k] = self._loss_sum.get(k, 0.0) + float(v)
+                    data_cnt += int(v)
+                else:
+                    self._loss_sum[k] = self._loss_sum.get(k, 0.0) + float(v)
+        return data_cnt
 
     def run(self):
         print('waiting training')
@@ -615,7 +632,10 @@ class Learner:
     def update_model(self, params, steps: int, state_blob: Optional[bytes] = None):
         print('updated model(%d)' % steps)
         self.model_epoch += 1
-        self.wrapper.params = jax.tree_util.tree_map(jnp.asarray, params)
+        # learner-side copy stays on HOST (numpy): it only feeds
+        # snapshots/checkpoints; per-leaf device uploads each epoch
+        # would pay a tunnel round trip per leaf
+        self.wrapper.params = jax.tree_util.tree_map(np.asarray, params)
         os.makedirs(self.args.get('model_dir', 'models'), exist_ok=True)
         raw = self.wrapper.params_bytes()
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
@@ -793,7 +813,7 @@ class Learner:
         # actor params live ON DEVICE, refreshed once per epoch — binding
         # the learner's numpy copy would re-upload the full parameter set
         # on every rollout/eval dispatch (ruinous through a WAN tunnel)
-        actor.params = jax.device_put(self.wrapper.params)
+        actor.params = put_tree(self.wrapper.params)
         env_args = args['env']
 
         def make_env_fn(i):
@@ -898,7 +918,7 @@ class Learner:
 
         while not self.shutdown_flag:
             if actor_epoch != self.model_epoch:   # follow latest epoch
-                actor.params = jax.device_put(self.wrapper.params)
+                actor.params = put_tree(self.wrapper.params)
                 actor_epoch = self.model_epoch
             dispatch_epoch = self.model_epoch
             if device_ingest:
@@ -970,6 +990,9 @@ class Learner:
         epoch_steps = 0
         epoch_t0 = time.time()
         eval_tracker: Dict[str, int] = {}
+        timing = os.environ.get('HANDYRL_TPU_TIMING') == '1'
+        tacc = {'dispatch': 0.0, 'fetch': 0.0, 'eval': 0.0, 'epoch': 0.0,
+                'iters': 0}
         # feed_device_chunk is one fetch behind dispatch; chunk -> epoch
         # attribution therefore uses the epoch captured at dispatch time
         epoch_of_dispatch = deque()
@@ -977,31 +1000,45 @@ class Learner:
         def account(prev):
             if prev is None:
                 return
-            done, outcome = prev
-            self.feed_device_chunk(done, outcome, epoch_of_dispatch.popleft())
+            self.feed_device_chunk(prev['done'], prev['outcome'],
+                                   epoch_of_dispatch.popleft())
+            if prev['metrics'] is not None:
+                pending_metrics.append(prev['metrics'])
 
         while not self.shutdown_flag:
             if actor_epoch != self.model_epoch:
-                actor.params = jax.device_put(self.wrapper.params)
+                actor.params = put_tree(self.wrapper.params)
                 actor_epoch = self.model_epoch
             epoch_of_dispatch.append(self.model_epoch)
             warm = self.num_returned_episodes < args['minimum_episodes']
+            t0 = time.time()
             if warm:
                 account(fp.warm_step(actor.params))
+                tacc['fetch'] += time.time() - t0
             else:
-                tr.state, prev, metrics = fp.train_step(
+                tr.state, prev = fp.train_step(
                     actor.params, tr.state, tr.data_cnt_ema)
+                t1 = time.time()
+                tacc['dispatch'] += t1 - t0
                 tr.steps += fp.sgd_steps
                 epoch_steps += fp.sgd_steps
-                pending_metrics.append(metrics)
                 account(prev)
+                tacc['fetch'] += time.time() - t1
+            tacc['iters'] += 1
 
+            t2 = time.time()
             self._run_eval_share(evaluator, eval_tracker)
+            tacc['eval'] += time.time() - t2
 
             if cadence.due(self.num_returned_episodes):
+                t3 = time.time()
                 self._fused_epoch(pending_metrics, epoch_steps,
                                   time.time() - epoch_t0, fp, evaluator)
-                pending_metrics = []
+                tacc['epoch'] += time.time() - t3
+                if timing:
+                    print('timing: %s' % json.dumps(
+                        {k: round(v, 2) for k, v in tacc.items()}))
+                pending_metrics.clear()   # account() closes over this list
                 epoch_steps = 0
                 epoch_t0 = time.time()
                 if 0 <= self.args['epochs'] <= self.model_epoch:
@@ -1023,7 +1060,7 @@ class Learner:
 
         data_cnt = 0
         loss_sum: Dict[str, float] = {}
-        for metrics in pending_metrics:
+        for metrics in pending_metrics:   # host floats — no device fetch
             for k, v in metrics.items():
                 if k == 'data_count':
                     data_cnt += int(v)
@@ -1039,13 +1076,17 @@ class Learner:
         if tr.replay is not None:
             tr.replay_stats['samples_drawn'] += (
                 epoch_steps * self.args['batch_size'])
-            # window count lives on device; mirror the ring size lazily
-            tr._ring_size_host = int(fp.size)
+            # ring size rides the per-chunk packed fetch — no device sync
+            tr._ring_size_host = fp.ring_size_host
             tr.replay_stats['windows_ingested'] = max(
                 tr.replay_stats['windows_ingested'], tr._ring_size_host)
 
-        params = jax.tree_util.tree_map(np.asarray, tr.state.params)
-        self.update_model(params, tr.steps, tr.state_bytes())
+        # ONE packed transfer for params + optimizer state (per-leaf
+        # np.asarray costs a tunnel round trip per leaf)
+        from .utils.fetch import fetch_tree
+        host_state = fetch_tree(tr.state)
+        self.update_model(host_state.params, tr.steps,
+                          tr.state_bytes(host_state))
         rec_extra = {'dispatches_gen': fp.dispatches,
                      'dispatches_eval': getattr(evaluator, 'dispatches', 0)}
         self._write_metrics(tr.steps, rec_extra)

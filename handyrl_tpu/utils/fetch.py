@@ -1,0 +1,108 @@
+"""Packed device->host transfers.
+
+On a tunneled TPU every DISTINCT array fetch pays one host round trip
+(~100-140 ms measured through the axon WAN tunnel) regardless of size,
+while bandwidth is cheap (a 4 MB array arrives in ~one round trip). Naive
+``np.asarray`` per pytree leaf therefore costs leaves x RTT — seconds for a
+parameter tree at every epoch boundary. ``fetch_tree`` flattens the tree
+into ONE device buffer per dtype (a tiny jitted concat, dispatched async)
+and pays one round trip per dtype group instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PACKERS: Dict[Tuple, Any] = {}
+_SPLITTERS: Dict[Tuple, Any] = {}
+
+
+def _packer(sig: Tuple) -> Any:
+    """One cached jitted concat per (dtype, shapes) signature."""
+    fn = _PACKERS.get(sig)
+    if fn is None:
+        fn = jax.jit(lambda ls: jnp.concatenate([l.reshape(-1) for l in ls]))
+        _PACKERS[sig] = fn
+    return fn
+
+
+def _splitter(sig: Tuple) -> Any:
+    """One cached jitted split+reshape per (dtype, shapes) signature."""
+    fn = _SPLITTERS.get(sig)
+    if fn is None:
+        _, shapes = sig
+
+        def split(flat):
+            out, pos = [], 0
+            for shape in shapes:
+                n = 1
+                for s in shape:
+                    n *= s
+                out.append(jax.lax.dynamic_slice(flat, (pos,), (n,))
+                           .reshape(shape))
+                pos += n
+            return out
+
+        fn = jax.jit(split)
+        _SPLITTERS[sig] = fn
+    return fn
+
+
+def fetch_tree(tree: Any) -> Any:
+    """Device pytree -> host numpy pytree in one round trip per dtype.
+
+    Leaves already on host (numpy / python scalars) pass through untouched.
+    Structure, shapes, and dtypes are preserved exactly.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    device_ix: Dict[Any, List[int]] = {}
+    out: List[Any] = [None] * len(leaves)
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array):
+            device_ix.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+        else:
+            out[i] = leaf
+    for dtype, idxs in device_ix.items():
+        group = [leaves[i] for i in idxs]
+        if len(group) == 1:
+            flat_host = np.asarray(group[0]).reshape(-1)
+        else:
+            sig = (str(dtype), tuple(g.shape for g in group))
+            flat_host = np.asarray(_packer(sig)(group))
+        pos = 0
+        for i, g in zip(idxs, group):
+            n = int(np.prod(g.shape)) if g.shape else 1
+            out[i] = flat_host[pos:pos + n].reshape(g.shape)
+            pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def put_tree(tree: Any) -> Any:
+    """Host numpy pytree -> device pytree in one upload per dtype.
+
+    The mirror of ``fetch_tree``: leaves are concatenated on the HOST, sent
+    as one buffer, and split back by a tiny cached jitted program — instead
+    of one `device_put` round trip per leaf (actor-params refresh happens
+    every epoch)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: Dict[Any, List[int]] = {}
+    out: List[Any] = [None] * len(leaves)
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        leaves[i] = arr
+        groups.setdefault(arr.dtype, []).append(i)
+    for dtype, idxs in groups.items():
+        group = [leaves[i] for i in idxs]
+        if len(group) == 1:
+            out[idxs[0]] = jax.device_put(group[0])
+            continue
+        shapes = tuple(tuple(g.shape) for g in group)
+        flat = np.concatenate([g.reshape(-1) for g in group])
+        parts = _splitter((str(dtype), shapes))(jax.device_put(flat))
+        for i, part in zip(idxs, parts):
+            out[i] = part
+    return jax.tree_util.tree_unflatten(treedef, out)

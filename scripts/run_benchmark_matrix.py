@@ -27,7 +27,11 @@ ROWS = {
         'train_args': {'batch_size': 64, 'forward_steps': 8,
                        'update_episodes': 200, 'minimum_episodes': 400,
                        'generation_envs': 64,
-                       'device_generation': True, 'device_replay': True},
+                       'device_generation': True, 'device_replay': True,
+                       # ~89 training samples per episode, the measured
+                       # ratio of the round-2 threaded run (192*64 samples
+                       # per ~136-episode chunk)
+                       'sgd_steps_per_chunk': 192},
     },
     'ttt-vtrace': {
         'env_args': {'env': 'TicTacToe'},
@@ -77,7 +81,11 @@ ROWS = {
                        'gamma': 0.99,
                        'policy_target': 'VTRACE', 'value_target': 'VTRACE',
                        'device_generation': True, 'device_replay': True,
-                       'device_chunk_steps': 32, 'eval_envs': 32},
+                       'device_chunk_steps': 32, 'eval_envs': 32,
+                       # ~265 training samples per episode, the measured
+                       # ratio of the round-2 threaded run (64*64 samples
+                       # per ~17-episode chunk)
+                       'sgd_steps_per_chunk': 64},
     },
 }
 
@@ -93,6 +101,7 @@ def run_row(name, epochs):
 
     t0 = time.time()
     learner = Learner(args=args)
+    init_s = time.time() - t0
     learner.run()
     wall = time.time() - t0
 
@@ -113,6 +122,7 @@ def run_row(name, epochs):
         'sgd_steps_per_sec': round(learner.trainer.last_steps_per_sec, 2),
         'win_rate_vs_random_last5': round(win_rate, 3) if win_rate else None,
         'eval_games': n, 'wall_s': round(wall, 1),
+        'init_s': round(init_s, 1),
         'time': time.strftime('%Y-%m-%d %H:%M:%S'),
     }
     with open('benchmarks.jsonl', 'a') as f:

@@ -228,7 +228,9 @@ def test_ingest_fills_ring_and_counts_episodes():
     assert int(n_windows) == 4
     assert int(size) == 4   # S//fs = 1 window per episode
     assert int(cursor) == 4
-    got = jax.tree_util.tree_map(lambda b: np.asarray(b[:4]), ring)
+    # ring rows are stored flat (TPU tile-padding); unflatten to inspect
+    got = wd.unflatten_rows(
+        jax.tree_util.tree_map(lambda b: np.asarray(b[:4]), ring))
     assert got['observation'].shape == (4, 2, 1, 2, 2)
     assert got['turn_mask'].shape == (4, 2, P, 1)
     # every stored window is fully inside its episode (fs=2 <= S=3)

@@ -57,7 +57,8 @@ def test_fused_matches_sequential_steps():
 
     fused = build_replay_update(module, LossConfig(), capacity=buf.capacity,
                                 batch_size=BATCH, num_steps=K,
-                                default_lr=DEFAULT_LR)
+                                default_lr=DEFAULT_LR,
+                                spec_fn=lambda: (buf.window_spec, buf.treedef))
     state = init_train_state(params)
     state, key_out, summed = fused(
         state, buf.buffers, jax.random.PRNGKey(5),
@@ -83,7 +84,8 @@ def test_fused_key_advances():
     buf, module, batch, params = _setup()
     fused = build_replay_update(module, LossConfig(), capacity=buf.capacity,
                                 batch_size=BATCH, num_steps=2,
-                                default_lr=DEFAULT_LR)
+                                default_lr=DEFAULT_LR,
+                                spec_fn=lambda: (buf.window_spec, buf.treedef))
     state = init_train_state(params)
     key = jax.random.PRNGKey(5)
     state, key2, _ = fused(state, buf.buffers, key,
