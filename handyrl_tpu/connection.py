@@ -257,25 +257,28 @@ def accept_socket_connections(port: int, timeout: Optional[float] = None,
 # event-loop hub
 
 
+_WRITER_EXIT = object()   # per-endpoint writer shutdown sentinel
+
+
 class Hub:
-    """Message multiplexer: one selector read loop + one writer thread.
+    """Message multiplexer: one selector read loop + one writer per endpoint.
 
     Incoming messages land in one inbox as ``(endpoint, message)``; outgoing
-    messages are posted to a shared outbox drained by the writer thread.
-    Reads never stall behind writes: a peer that stops consuming can block
-    the writer at most ``SEND_TIMEOUT`` seconds (sockets get a send
-    deadline on attach), after which it is detached — the read loop keeps
-    serving every other endpoint throughout. Endpoints may be attached /
-    detached from any thread at any time (workers are elastic); a failed
-    read or write detaches the endpoint.
+    messages are posted to a PER-ENDPOINT outbox drained by that endpoint's
+    own writer thread, so a peer that stops consuming delays only its own
+    sends — never another peer's RPC round trip. A stalled peer is detached
+    when its socket send exceeds ``SEND_TIMEOUT`` (deadline set on attach)
+    or its outbox backs up past ``OUTBOX_MAX`` queued messages. Endpoints
+    may be attached / detached from any thread at any time (workers are
+    elastic); a failed read or write detaches the endpoint.
     """
 
     SEND_TIMEOUT = 30.0
+    OUTBOX_MAX = 512
 
     def __init__(self, endpoints: Optional[List] = None, inbox_max: int = 256):
         self._inbox: queue.Queue = queue.Queue(maxsize=inbox_max)
-        self._outbox: queue.Queue = queue.Queue()
-        self._attached: set = set()
+        self._outboxes: Dict[Any, queue.Queue] = {}
         self._commands: deque = deque()
         self._lock = threading.Lock()
         self._wake_r, self._wake_w = socket.socketpair()
@@ -285,13 +288,12 @@ class Hub:
         for ep in endpoints or []:
             self.attach(ep)
         threading.Thread(target=self._read_loop, daemon=True).start()
-        threading.Thread(target=self._write_loop, daemon=True).start()
 
     # -- public api (any thread) --
 
     def count(self) -> int:
         with self._lock:
-            return len(self._attached)
+            return len(self._outboxes)
 
     # QueueCommunicator-compatible alias used by the learner's server loop
     connection_count = count
@@ -301,17 +303,26 @@ class Hub:
 
     def send(self, endpoint, msg):
         with self._lock:
-            if endpoint not in self._attached:
-                return
-        self._outbox.put((endpoint, msg))
+            outbox = self._outboxes.get(endpoint)
+        if outbox is None:      # already detached: drop, like a dead socket
+            return
+        try:
+            outbox.put_nowait(msg)
+        except queue.Full:      # peer hopelessly behind — treat as stalled
+            self.detach(endpoint)
 
     def attach(self, endpoint):
         sock = getattr(endpoint, 'sock', None)
         if sock is not None:
-            sock.settimeout(self.SEND_TIMEOUT)   # bound writer-thread stalls
+            sock.settimeout(self.SEND_TIMEOUT)   # bound writer stalls
+        outbox: queue.Queue = queue.Queue(maxsize=self.OUTBOX_MAX)
         with self._lock:
-            self._attached.add(endpoint)
+            if endpoint in self._outboxes:
+                return
+            self._outboxes[endpoint] = outbox
             self._commands.append(('+', endpoint))
+        threading.Thread(target=self._write_loop, args=(endpoint, outbox),
+                         daemon=True).start()
         self._wake()
 
     # API name kept for operator familiarity with the reference logs
@@ -320,8 +331,13 @@ class Hub:
     def detach(self, endpoint):
         print('disconnected')
         with self._lock:
-            self._attached.discard(endpoint)
+            outbox = self._outboxes.pop(endpoint, None)
             self._commands.append(('-', endpoint))
+        if outbox is not None:
+            try:                          # fast writer wake; the writer also
+                outbox.put_nowait(_WRITER_EXIT)   # polls attachment, so a
+            except queue.Full:            # full outbox can't wedge detach
+                pass
         self._wake()
 
     # -- loop internals --
@@ -347,17 +363,23 @@ class Hub:
             except (KeyError, ValueError, OSError):
                 pass
 
-    def _write_loop(self):
+    def _write_loop(self, ep, outbox: queue.Queue):
+        """Drain ONE endpoint's outbox; exit when it is detached."""
         while True:
-            ep, msg = self._outbox.get()
-            with self._lock:
-                live = ep in self._attached
-            if not live:
+            try:
+                msg = outbox.get(timeout=1.0)
+            except queue.Empty:
+                with self._lock:
+                    if self._outboxes.get(ep) is not outbox:
+                        return        # detached while idle
                 continue
+            if msg is _WRITER_EXIT:
+                return
             try:
                 ep.send(msg)
             except (OSError, ValueError, TimeoutError, AttributeError):
                 self.detach(ep)   # AttributeError: closed while queued
+                return
 
     def _read_loop(self):
         while True:

@@ -616,6 +616,16 @@ class Learner:
         self._trainer_thread: Optional[threading.Thread] = None
 
         self._metrics_path = args.get('metrics_jsonl') or ''
+        # optional wall-clock budget (absolute unix time): long quality runs
+        # (scripts/run_north_star.py) stop at the next epoch boundary so the
+        # final checkpoint lands inside the budget window
+        self._deadline = float(os.environ.get('HANDYRL_TPU_DEADLINE', 0) or 0)
+
+    def _past_epoch_budget(self) -> bool:
+        """True when the epoch budget or the wall-clock deadline is spent."""
+        if 0 <= self.args['epochs'] <= self.model_epoch:
+            return True
+        return self._deadline > 0 and time.time() >= self._deadline
 
     # -- checkpoints ------------------------------------------------------
     def model_path(self, model_id: int) -> str:
@@ -917,6 +927,8 @@ class Learner:
             self.feed_episodes(episodes)
 
         while not self.shutdown_flag:
+            if self._deadline and time.time() >= self._deadline:
+                break                      # wall-clock budget spent mid-epoch
             if actor_epoch != self.model_epoch:   # follow latest epoch
                 actor.params = put_tree(self.wrapper.params)
                 actor_epoch = self.model_epoch
@@ -944,7 +956,7 @@ class Learner:
 
             if cadence.due(self.num_returned_episodes):
                 self.update()
-                if 0 <= self.args['epochs'] <= self.model_epoch:
+                if self._past_epoch_budget():
                     self.shutdown_flag = True
 
         # account the one speculative chunk still in the pipeline
@@ -1006,6 +1018,8 @@ class Learner:
                 pending_metrics.append(prev['metrics'])
 
         while not self.shutdown_flag:
+            if self._deadline and time.time() >= self._deadline:
+                break                      # wall-clock budget spent mid-epoch
             if actor_epoch != self.model_epoch:
                 actor.params = put_tree(self.wrapper.params)
                 actor_epoch = self.model_epoch
@@ -1041,7 +1055,7 @@ class Learner:
                 pending_metrics.clear()   # account() closes over this list
                 epoch_steps = 0
                 epoch_t0 = time.time()
-                if 0 <= self.args['epochs'] <= self.model_epoch:
+                if self._past_epoch_budget():
                     self.shutdown_flag = True
         account(fp.drain())
         if hasattr(evaluator, 'drain'):
@@ -1197,7 +1211,7 @@ class Learner:
 
             if cadence.due(self.num_returned_episodes):
                 self.update()
-                if 0 <= self.args['epochs'] <= self.model_epoch:
+                if self._past_epoch_budget():
                     self.shutdown_flag = True
         print('finished server')
 

@@ -1,0 +1,96 @@
+"""Hub fairness: one stalled peer must not delay another peer's traffic.
+
+The reference serves each worker with its own connection thread
+(reference connection.py:198-244), so a stalled worker never slows the
+rest. The Hub keeps that property with per-endpoint outboxes + writer
+threads behind one selector read loop; these tests pin it down.
+"""
+
+import socket
+import time
+
+import pytest
+
+from handyrl_tpu.connection import FramedConnection, Hub
+
+
+def _pair(sndbuf=None):
+    a, b = socket.socketpair()
+    if sndbuf is not None:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, sndbuf)
+    return FramedConnection(a), FramedConnection(b)
+
+
+def test_roundtrip_two_peers():
+    hub = Hub()
+    ep1, client1 = _pair()
+    ep2, client2 = _pair()
+    hub.attach(ep1)
+    hub.attach(ep2)
+    assert hub.count() == 2
+    client1.send({'who': 1})
+    client2.send({'who': 2})
+    got = {hub.recv(timeout=5)[1]['who'], hub.recv(timeout=5)[1]['who']}
+    assert got == {1, 2}
+    hub.send(ep1, 'a')
+    hub.send(ep2, 'b')
+    assert client1.recv() == 'a'
+    assert client2.recv() == 'b'
+
+
+def test_stalled_peer_does_not_block_others():
+    """A peer that never reads (socket buffers full, writer thread wedged in
+    sendall) must not delay a healthy peer's round trip."""
+    hub = Hub()
+    stalled_ep, _stalled_client = _pair(sndbuf=4096)   # client never reads
+    live_ep, live_client = _pair()
+    hub.attach(stalled_ep)
+    hub.attach(live_ep)
+
+    blob = b'x' * 65536
+    for _ in range(8):            # far beyond 4 KB of kernel buffer
+        hub.send(stalled_ep, blob)
+    time.sleep(0.2)               # let the stalled writer wedge in sendall
+
+    t0 = time.time()
+    for i in range(5):
+        hub.send(live_ep, {'seq': i})
+        assert live_client.recv() == {'seq': i}
+    assert time.time() - t0 < 2.0
+
+
+def test_outbox_overflow_detaches_stalled_peer(monkeypatch):
+    monkeypatch.setattr(Hub, 'OUTBOX_MAX', 4)
+    hub = Hub()
+    stalled_ep, _client = _pair(sndbuf=4096)
+    hub.attach(stalled_ep)
+    blob = b'y' * 65536
+    deadline = time.time() + 10
+    while hub.count() == 1 and time.time() < deadline:
+        hub.send(stalled_ep, blob)
+        time.sleep(0.01)
+    assert hub.count() == 0       # hopelessly-behind peer detached
+
+
+def test_detach_drops_sends():
+    hub = Hub()
+    ep, client = _pair()
+    hub.attach(ep)
+    hub.send(ep, 'first')
+    assert client.recv() == 'first'
+    hub.detach(ep)
+    hub.send(ep, 'second')        # dropped, no error
+    assert hub.count() == 0
+
+
+@pytest.mark.parametrize('n', [8])
+def test_many_peers_fan_out(n):
+    hub = Hub()
+    pairs = [_pair() for _ in range(n)]
+    for ep, _ in pairs:
+        hub.attach(ep)
+    for i, (ep, _) in enumerate(pairs):
+        hub.send(ep, {'rank': i})
+    for i, (_, client) in enumerate(pairs):
+        assert client.recv() == {'rank': i}
