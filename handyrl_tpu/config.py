@@ -48,6 +48,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'replay_fused_steps': 8,      # SGD steps fused into one device program in device_replay mode
     'fused_pipeline': True,       # one dispatch = rollout chunk + ingest + K SGD steps (device_ingest configs)
     'sgd_steps_per_chunk': None,  # fused-pipeline SGD steps per rollout chunk (pins the replay ratio); None = 16
+    'checkpoint_interval': 1,     # fused loop: write model/trainer ckpt files every N epochs (params still refresh on device every epoch; a final flush always lands on shutdown)
     'model_dir': 'models',        # checkpoint directory
     'metrics_jsonl': '',          # optional structured metrics path
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id

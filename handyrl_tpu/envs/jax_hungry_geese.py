@@ -127,13 +127,19 @@ def _body_occupancy(cells, length, alive, include_heads):
 
 
 def step(state: State, actions: jnp.ndarray) -> State:
-    """Apply (N, P) actions; dead players' actions are ignored."""
+    """Apply (N, P) actions; dead players' actions are ignored.
+
+    Canonical kaggle resolution order (docs/geese_rules.md), vectorized:
+    reversal death (unconditional, even at length 1) -> move + eat ->
+    SELF-collision against the remaining own cells (popped tail excluded,
+    new head excluded) -> hunger pop / starvation -> ONE simultaneous
+    cross-goose occupancy pass killing any head whose cell counts > 1.
+    Geese emptied before the occupancy pass contribute nothing to it."""
     prev_heads = jnp.where(state.alive, state.cells[:, :, 0], -1)
 
-    # 1. reversal deaths (only with a body to reverse onto)
+    # 1. reversal deaths: canonical has NO length guard
     reversed_ = (state.last_action >= 0) & \
-        (actions == OPPOSITE[jnp.clip(state.last_action, 0, 3)]) & \
-        (state.length > 1)
+        (actions == OPPOSITE[jnp.clip(state.last_action, 0, 3)])
     alive = state.alive & ~reversed_
 
     # 2. move heads, eat
@@ -144,22 +150,27 @@ def step(state: State, actions: jnp.ndarray) -> State:
                             axis=2)
     length = state.length + ate.astype(jnp.int32)
 
-    # 3. starvation every HUNGER_RATE steps
+    # 3. self-collision BEFORE hunger: new buffer indices 1..length-1 hold
+    # exactly the canonical post-pop pre-insert goose (old head kept, old
+    # tail dropped unless it ate)
+    idx = jnp.arange(MAX_LEN)[None, None, :]
+    own_valid = (idx >= 1) & (idx < length[..., None])
+    self_hit = ((cells == new_heads[..., None]) & own_valid).any(axis=2) \
+        & alive
+    alive = alive & ~self_hit
+
+    # 4. starvation every HUNGER_RATE steps
     steps = state.steps + 1
     starve = (steps % HUNGER_RATE == 0)
     length = length - (starve[:, None] & alive).astype(jnp.int32)
-    starved = alive & (length <= 0)
     alive = alive & (length > 0)
 
-    # 4. collisions on the post-move board
-    body_occ = _body_occupancy(cells, length, alive, include_heads=False)
+    # 5. simultaneous cross-goose pass: occupancy over every cell (heads
+    # included) of every surviving goose; head cell count > 1 kills
+    occ = _body_occupancy(cells, length, alive, include_heads=True)
     head_cell = cells[:, :, 0]
-    head_onehot = jax.nn.one_hot(jnp.where(alive, head_cell, N_CELLS),
-                                 N_CELLS + 1, dtype=jnp.float32)
-    head_count = head_onehot.sum(axis=1)[:, :N_CELLS]
-    hits_body = jnp.take_along_axis(body_occ, head_cell, axis=1) > 0
-    head_clash = jnp.take_along_axis(head_count, head_cell, axis=1) > 1
-    collided = alive & (hits_body | head_clash)
+    collided = alive & \
+        (jnp.take_along_axis(occ, head_cell, axis=1) > 1)
     alive = alive & ~collided
 
     length = jnp.where(alive, length, 0)

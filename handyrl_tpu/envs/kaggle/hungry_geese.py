@@ -92,11 +92,22 @@ class Environment(BaseEnvironment):
 
     # -- transitions -------------------------------------------------------
     def step(self, actions: Dict[int, Optional[int]]):
+        """Canonical kaggle resolution order (see the rules-source note in
+        docs/geese_rules.md): per agent — reversal death (unconditional, even
+        at length 1), move + eat-or-pop-tail, SELF-collision against the
+        remaining own cells (old head still present, popped tail absent, new
+        head not yet inserted), head insert, hunger pop + starvation death —
+        then ONE simultaneous cross-goose pass: a histogram over every cell
+        of every surviving goose kills any goose whose head cell counts > 1.
+        Geese emptied in the per-agent phase (reversed / self-collided /
+        starved) contribute nothing to the histogram, so their vacated cells
+        are safe to enter the same step."""
         self.prev_geese = [list(g) for g in self.geese]
         self.step_count += 1
         acted: Dict[int, int] = {}
+        hungry = self.step_count % HUNGER_RATE == 0
 
-        # move phase
+        # per-agent phase
         for p in range(self.NUM_AGENTS):
             if not self.alive[p]:
                 continue
@@ -105,39 +116,34 @@ class Environment(BaseEnvironment):
             acted[p] = action
             goose = self.geese[p]
             if (p in self.last_actions
-                    and action == OPPOSITE[self.last_actions[p]]
-                    and len(goose) > 1):
-                self.alive[p] = False      # reversed onto its own neck
+                    and action == OPPOSITE[self.last_actions[p]]):
+                self.alive[p] = False      # reversal: dies at ANY length
                 self.geese[p] = []
                 continue
             head = _move(goose[0], action)
-            goose.insert(0, head)
             if head in self.food:
                 self.food.remove(head)     # grow: keep the tail
             else:
                 goose.pop()
+            if head in goose:              # self collision (pre-insert)
+                self.alive[p] = False
+                self.geese[p] = []
+                continue
+            goose.insert(0, head)
+            if hungry:
+                goose.pop()
+                if not goose:
+                    self.alive[p] = False  # starved
 
-        # starvation phase
-        if self.step_count % HUNGER_RATE == 0:
-            for p in range(self.NUM_AGENTS):
-                if self.alive[p] and self.geese[p]:
-                    self.geese[p].pop()
-                    if not self.geese[p]:
-                        self.alive[p] = False
-
-        # collision phase (simultaneous: evaluated on the post-move board)
-        head_count: Dict[int, int] = {}
-        bodies = set()
+        # simultaneous cross-goose collisions
+        count: Dict[int, int] = {}
+        for p in range(self.NUM_AGENTS):
+            for cell in self.geese[p]:
+                count[cell] = count.get(cell, 0) + 1
         for p in range(self.NUM_AGENTS):
             if not self.alive[p] or not self.geese[p]:
                 continue
-            head_count[self.geese[p][0]] = head_count.get(self.geese[p][0], 0) + 1
-            bodies.update(self.geese[p][1:])
-        for p in range(self.NUM_AGENTS):
-            if not self.alive[p] or not self.geese[p]:
-                continue
-            head = self.geese[p][0]
-            if head in bodies or head_count[head] > 1:
+            if count[self.geese[p][0]] > 1:
                 self.alive[p] = False
                 self.geese[p] = []
 

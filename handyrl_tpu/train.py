@@ -639,9 +639,18 @@ class Learner:
         return os.path.join(self.args.get('model_dir', 'models'),
                             'trainer_state.ckpt')
 
-    def update_model(self, params, steps: int, state_blob: Optional[bytes] = None):
+    def update_model(self, params, steps: int,
+                     state_blob: Optional[bytes] = None, bump: bool = True,
+                     write_files: bool = True):
+        """Advance the model epoch; persist snapshot + ckpt files unless
+        ``write_files`` is False (checkpoint_interval's skip epochs, where
+        params never leave the device)."""
         print('updated model(%d)' % steps)
-        self.model_epoch += 1
+        if bump:
+            self.model_epoch += 1
+        if not write_files:
+            return
+        self._last_ckpt_epoch = self.model_epoch
         # learner-side copy stays on HOST (numpy): it only feeds
         # snapshots/checkpoints; per-leaf device uploads each epoch
         # would pay a tunnel round trip per leaf
@@ -1017,11 +1026,20 @@ class Learner:
             if prev['metrics'] is not None:
                 pending_metrics.append(prev['metrics'])
 
+        # actor/eval params refresh DEVICE-to-device from the train state:
+        # no host round trip, and correct even on epochs where
+        # checkpoint_interval skipped the host snapshot. A real copy (not an
+        # alias) is required — the next fused dispatch donates tr.state.
+        copy_params = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.copy, p))
+
         while not self.shutdown_flag:
             if self._deadline and time.time() >= self._deadline:
                 break                      # wall-clock budget spent mid-epoch
             if actor_epoch != self.model_epoch:
-                actor.params = put_tree(self.wrapper.params)
+                actor.params = (copy_params(tr.state.params)
+                                if tr.state is not None
+                                else put_tree(self.wrapper.params))
                 actor_epoch = self.model_epoch
             epoch_of_dispatch.append(self.model_epoch)
             warm = self.num_returned_episodes < args['minimum_episodes']
@@ -1061,6 +1079,14 @@ class Learner:
         if hasattr(evaluator, 'drain'):
             self.feed_results(evaluator.drain(),
                               model_id=eval_tracker.get('prev'))
+        if (tr.state is not None
+                and getattr(self, '_last_ckpt_epoch', 0) != self.model_epoch):
+            # checkpoint_interval skipped the file write for the last
+            # epoch(s); flush a final checkpoint so resume loses nothing
+            from .utils.fetch import fetch_tree
+            host_state = fetch_tree(tr.state)
+            self.update_model(host_state.params, tr.steps,
+                              tr.state_bytes(host_state), bump=False)
 
     def _fused_epoch(self, pending_metrics, epoch_steps, epoch_wall,
                      fp, evaluator):
@@ -1095,12 +1121,22 @@ class Learner:
             tr.replay_stats['windows_ingested'] = max(
                 tr.replay_stats['windows_ingested'], tr._ring_size_host)
 
-        # ONE packed transfer for params + optimizer state (per-leaf
-        # np.asarray costs a tunnel round trip per leaf)
-        from .utils.fetch import fetch_tree
-        host_state = fetch_tree(tr.state)
-        self.update_model(host_state.params, tr.steps,
-                          tr.state_bytes(host_state))
+        # Fetching + serializing the full train state dominates short
+        # epochs on a tunneled device (~40% of a 100k-episode geese run):
+        # with checkpoint_interval > 1, intermediate epochs skip the host
+        # round trip entirely — the actor/eval params refresh device-to-
+        # device in the fused loop, so nothing here needs host bytes.
+        interval = int(self.args.get('checkpoint_interval') or 1)
+        final = 0 <= self.args['epochs'] <= self.model_epoch + 1
+        if interval <= 1 or (self.model_epoch + 1) % interval == 0 or final:
+            # ONE packed transfer for params + optimizer state (per-leaf
+            # np.asarray costs a tunnel round trip per leaf)
+            from .utils.fetch import fetch_tree
+            host_state = fetch_tree(tr.state)
+            self.update_model(host_state.params, tr.steps,
+                              tr.state_bytes(host_state))
+        else:
+            self.update_model(None, tr.steps, write_files=False)
         rec_extra = {'dispatches_gen': fp.dispatches,
                      'dispatches_eval': getattr(evaluator, 'dispatches', 0)}
         self._write_metrics(tr.steps, rec_extra)
