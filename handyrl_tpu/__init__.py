@@ -35,8 +35,27 @@ def setup_compile_cache():
 
         if jax.config.jax_compilation_cache_dir:
             return   # operator already chose a cache; leave it alone
+        # Scope the cache per MACHINE: XLA:CPU cache entries are AOT
+        # executables specialized to the compiling host's CPU features, and
+        # loading one compiled elsewhere can SIGILL/segfault ("machine type
+        # used for compilation doesn't match the machine type for
+        # execution"). A home dir shared across containers/hosts must not
+        # share entries, so the path embeds a CPU-capability fingerprint.
+        import hashlib
+        import platform
+        try:
+            with open('/proc/cpuinfo') as f:
+                # x86 calls the capability line 'flags', ARM 'Features';
+                # mix in the machine arch so hosts without either line
+                # still separate by ISA
+                caps = [l for l in f
+                        if l.startswith(('flags', 'Features'))][:1]
+        except OSError:
+            caps = []
+        fp = hashlib.sha1(
+            (platform.machine() + ''.join(caps)).encode()).hexdigest()[:12]
         cache_dir = os.path.join(os.path.expanduser('~'), '.cache',
-                                 'handyrl_tpu_xla')
+                                 'handyrl_tpu_xla', fp)
         jax.config.update('jax_compilation_cache_dir', cache_dir)
         # cache across backends including CPU, and even quick compiles —
         # the test suite and bench re-trace the same programs constantly

@@ -396,13 +396,24 @@ class DeviceEvaluator:
     """
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
-                 n_envs: int = 64, chunk_steps: int = 16, seed: int = 77):
+                 n_envs: int = 64, chunk_steps: int = 16, seed: int = 77,
+                 mesh=None):
         self.args = args
         self.chunk_steps = chunk_steps
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         # one evaluated seat per env, rotated on every reset so first/second
         # (and every goose slot) are balanced like evaluate_mp's scheduler
         self.seat = jnp.arange(n_envs, dtype=jnp.int32) % env_mod.NUM_PLAYERS
+        if mesh is not None:
+            # eval envs sharded over 'data' alongside the fused trainer
+            # (params arrive replicated); the plain-jit rollout partitions
+            # under GSPMD — eval is embarrassingly parallel over envs
+            from .parallel.mesh import replicated_sharding, shard_batch
+            self.state = shard_batch(mesh, self.state)
+            if self.hidden is not None:
+                self.hidden = shard_batch(mesh, self.hidden)
+            self.seat = shard_batch(mesh, self.seat)
+            self.rng = jax.device_put(self.rng, replicated_sharding(mesh))
         self._pending = None
         self._pack = None
         self.dispatches = 0

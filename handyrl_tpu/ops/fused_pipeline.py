@@ -52,27 +52,55 @@ class FusedPipeline:
     def __init__(self, env_mod, wrapper, cfg: LossConfig, windower,
                  args: Dict[str, Any], n_envs: int, chunk_steps: int,
                  sgd_steps: int, batch_size: int,
-                 default_lr: float = 3e-8, seed: int = 0):
+                 default_lr: float = 3e-8, seed: int = 0, mesh=None):
         self.chunk_steps = chunk_steps
         self.sgd_steps = sgd_steps
+        self.mesh = mesh
+        ndev = int(np.prod(list(mesh.shape.values()))) if mesh else 1
+        self.ndev = ndev
+        if mesh is not None:
+            assert n_envs % ndev == 0 and batch_size % ndev == 0, \
+                'generation_envs and batch_size must divide the mesh'
+            assert windower.capacity >= 1, \
+                'replay capacity must be >= 1 ring row per shard'
+        n_loc = n_envs // ndev            # per-shard envs
+        b_loc = batch_size // ndev        # per-shard SGD batch slice
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         rollout_chunk = make_gen_body(env_mod, wrapper.module.apply,
                                       self.recurrent, self.simultaneous)
         ingest = windower.ingest_fn()
-        update = _update_core(wrapper.module, cfg, make_optimizer())
+        update = _update_core(wrapper.module, cfg, make_optimizer(),
+                              axis_name='data' if mesh is not None else None)
+        # windower.capacity is PER-SHARD on a mesh (Learner divides the ring
+        # budget by the device count); the global ring has ndev * capacity rows
         capacity = windower.capacity
         self.capacity = capacity
         self.dispatches = 0
 
         # ring/windower state allocated from the record shapes (eval_shape:
-        # nothing runs on device for this)
+        # nothing runs on device for this). On a mesh the GLOBAL shapes are
+        # allocated (env axis = n_envs, ring axis = ndev * capacity) and
+        # sharded over 'data'; each shard_map body sees the local slice.
         rec_spec = jax.eval_shape(
             lambda p, s, h, r: rollout_chunk(p, s, h, r, chunk_steps),
             wrapper.params, self.state, self.hidden, self.rng)[3]
         self.wstate = windower.init_state(rec_spec)
-        self.ring = windower.init_ring(rec_spec)
-        self.cursor = jnp.zeros((), jnp.int32)
-        self.size = jnp.zeros((), jnp.int32)
+        ring_local = windower.init_ring(rec_spec)   # sets window_spec
+        if mesh is None:
+            self.ring = ring_local
+            self.cursor = jnp.zeros((), jnp.int32)
+            self.size = jnp.zeros((), jnp.int32)
+        else:
+            self.ring = {k: jnp.zeros((ndev * capacity,) + v.shape[1:],
+                                      v.dtype)
+                         for k, v in ring_local.items()}
+            # per-shard ring cursors/sizes and PRNG streams, stored as
+            # sharded (ndev,)-leading arrays
+            self.cursor = jnp.zeros((ndev,), jnp.int32)
+            self.size = jnp.zeros((ndev,), jnp.int32)
+            self.rng = jax.random.split(jax.random.fold_in(
+                jax.random.PRNGKey(seed), 7), ndev)
+            self._shard_loop_state(mesh)
 
         self.num_players = int(env_mod.NUM_PLAYERS)
         self._metric_keys: list = []   # filled at trace time, static order
@@ -96,31 +124,18 @@ class FusedPipeline:
             parts += [v.astype(jnp.float32).reshape(1) for v in metric_vals]
             return jnp.concatenate(parts)
 
-        def warmup(actor_params, env_state, hidden, wstate, ring,
-                   cursor, size, rng):
-            (env_state, hidden, wstate, ring, cursor, size, rng,
-             done, outcome) = gen_ingest(
-                actor_params, env_state, hidden, wstate, ring, cursor,
-                size, rng)
-            return (env_state, hidden, wstate, ring, cursor, size, rng,
-                    pack(done, outcome, size, []))
-
-        def fused(actor_params, train_state: TrainState, env_state, hidden,
-                  wstate, ring, cursor, size, rng, data_cnt_ema):
-            (env_state, hidden, wstate, ring, cursor, size, rng,
-             done, outcome) = gen_ingest(
-                actor_params, env_state, hidden, wstate, ring, cursor,
-                size, rng)
-
+        def sgd_tail(train_state, ring, cursor, size, rng, data_cnt_ema,
+                     batch_rows):
+            """K recency-sampled SGD steps on this shard's ring slice."""
             def body(carry, _):
                 ts, key = carry
                 key, sub = jax.random.split(key)
                 slots = recency_slots(sub, size, cursor, capacity,
-                                      batch_size)
+                                      batch_rows)
                 # ring rows are stored flat (device_windows.init_ring);
                 # restore the (B, T, P, ...) window shape after the gather
                 batch = {k: ring[k][slots].reshape(
-                            (batch_size,) + windower.window_spec[k][0])
+                            (batch_rows,) + windower.window_spec[k][0])
                          for k in ring}
                 lr = (default_lr * data_cnt_ema
                       / (1 + ts.steps.astype(jnp.float32) * 1e-5))
@@ -133,9 +148,32 @@ class FusedPipeline:
                 lambda m: jnp.sum(m, axis=0), stacked)
             keys = sorted(metrics)         # static: recorded at trace time
             self._metric_keys[:] = keys
-            return (train_state, env_state, hidden, wstate, ring, cursor,
-                    size, rng,
-                    pack(done, outcome, size, [metrics[k] for k in keys]))
+            return train_state, rng, [metrics[k] for k in keys]
+
+        if mesh is None:
+            def warmup(actor_params, env_state, hidden, wstate, ring,
+                       cursor, size, rng):
+                (env_state, hidden, wstate, ring, cursor, size, rng,
+                 done, outcome) = gen_ingest(
+                    actor_params, env_state, hidden, wstate, ring, cursor,
+                    size, rng)
+                return (env_state, hidden, wstate, ring, cursor, size, rng,
+                        pack(done, outcome, size, []))
+
+            def fused(actor_params, train_state: TrainState, env_state,
+                      hidden, wstate, ring, cursor, size, rng, data_cnt_ema):
+                (env_state, hidden, wstate, ring, cursor, size, rng,
+                 done, outcome) = gen_ingest(
+                    actor_params, env_state, hidden, wstate, ring, cursor,
+                    size, rng)
+                train_state, rng, mvals = sgd_tail(
+                    train_state, ring, cursor, size, rng, data_cnt_ema,
+                    batch_size)
+                return (train_state, env_state, hidden, wstate, ring, cursor,
+                        size, rng, pack(done, outcome, size, mvals))
+        else:
+            warmup, fused = self._build_sharded(
+                mesh, gen_ingest, sgd_tail, pack, b_loc)
 
         # donate everything the pipeline owns plus the train state; actor
         # params and the EMA scalar are plain (re-used) inputs
@@ -145,6 +183,95 @@ class FusedPipeline:
                               donate_argnums=tuple(range(1, 10)))
         self._pending = None   # (pack_future, has_metrics), one deep
         self.ring_size_host = 0
+
+    # -- multi-chip construction -------------------------------------------
+    def _shard_loop_state(self, mesh):
+        """Lay the loop state out over the mesh: env/hidden/windower state
+        and per-shard cursors split along 'data', ring rows split along the
+        capacity axis."""
+        from ..parallel.mesh import shard_batch
+        self.state = shard_batch(mesh, self.state)
+        if self.hidden is not None:
+            self.hidden = shard_batch(mesh, self.hidden)
+        self.wstate = shard_batch(mesh, self.wstate)
+        self.ring = shard_batch(mesh, self.ring)
+        self.cursor = shard_batch(mesh, self.cursor)
+        self.size = shard_batch(mesh, self.size)
+        self.rng = shard_batch(mesh, self.rng)
+
+    def _build_sharded(self, mesh, gen_ingest, sgd_tail, pack, b_loc):
+        """shard_map'd variants: every shard runs rollout + ingest on its
+        own envs and ring slice; the SGD tail samples the per-shard batch
+        slice and psums grads/metrics inside the update (train_step.py),
+        so train_state stays replicated with no broadcast. The only
+        cross-chip traffic in steady state is the gradient/metric psum —
+        the layout How-to-Scale calls pure data parallelism, riding ICI."""
+        from functools import partial
+
+        try:
+            # jax >= 0.8: jax.shard_map, replication check named check_vma
+            shard_map = partial(jax.shard_map, check_vma=False)
+        except AttributeError:         # older jax
+            from jax.experimental.shard_map import shard_map
+            shard_map = partial(shard_map, check_rep=False)
+        from jax.sharding import PartitionSpec as P
+
+        D, R = P('data'), P()
+
+        def shard_warm(actor_params, env_state, hidden, wstate, ring,
+                       cursor, size, rng):
+            (env_state, hidden, wstate, ring, c, s, k,
+             done, outcome) = gen_ingest(
+                actor_params, env_state, hidden, wstate, ring,
+                cursor[0], size[0], rng[0])
+            size_tot = jax.lax.psum(s, 'data')
+            return (env_state, hidden, wstate, ring, c[None], s[None],
+                    k[None], done, outcome, size_tot)
+
+        def shard_fused(actor_params, train_state, env_state, hidden,
+                        wstate, ring, cursor, size, rng, data_cnt_ema):
+            (env_state, hidden, wstate, ring, c, s, k,
+             done, outcome) = gen_ingest(
+                actor_params, env_state, hidden, wstate, ring,
+                cursor[0], size[0], rng[0])
+            train_state, k, mvals = sgd_tail(
+                train_state, ring, c, s, k, data_cnt_ema, b_loc)
+            size_tot = jax.lax.psum(s, 'data')
+            return (train_state, env_state, hidden, wstate, ring, c[None],
+                    s[None], k[None], done, outcome, size_tot,
+                    jnp.stack(mvals) if mvals else jnp.zeros((0,)))
+
+        sm_warm = shard_map(
+            shard_warm, mesh=mesh,
+            in_specs=(R, D, D, D, D, D, D, D),
+            out_specs=(D, D, D, D, D, D, D, P(None, 'data'),
+                       P(None, 'data'), R))
+        sm_fused = shard_map(
+            shard_fused, mesh=mesh,
+            in_specs=(R, R, D, D, D, D, D, D, D, R),
+            out_specs=(R, D, D, D, D, D, D, D, P(None, 'data'),
+                       P(None, 'data'), R, R))
+
+        def warmup(actor_params, env_state, hidden, wstate, ring,
+                   cursor, size, rng):
+            (env_state, hidden, wstate, ring, cursor, size, rng,
+             done, outcome, size_tot) = sm_warm(
+                actor_params, env_state, hidden, wstate, ring, cursor,
+                size, rng)
+            return (env_state, hidden, wstate, ring, cursor, size, rng,
+                    pack(done, outcome, size_tot, []))
+
+        def fused(actor_params, train_state, env_state, hidden, wstate,
+                  ring, cursor, size, rng, data_cnt_ema):
+            (train_state, env_state, hidden, wstate, ring, cursor, size,
+             rng, done, outcome, size_tot, mvec) = sm_fused(
+                actor_params, train_state, env_state, hidden, wstate,
+                ring, cursor, size, rng, data_cnt_ema)
+            mvals = [mvec[i] for i in range(len(self._metric_keys))]
+            return (train_state, env_state, hidden, wstate, ring, cursor,
+                    size, rng, pack(done, outcome, size_tot, mvals))
+
+        return warmup, fused
 
     # -- dispatch helpers --------------------------------------------------
     def _parse(self, pending):

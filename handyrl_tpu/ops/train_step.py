@@ -43,8 +43,15 @@ def init_train_state(params) -> TrainState:
                       steps=jnp.zeros((), jnp.int32))
 
 
-def _update_core(module, cfg: LossConfig, optimizer):
-    """The un-jitted single SGD step shared by every compiled variant."""
+def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
+    """The un-jitted single SGD step shared by every compiled variant.
+
+    With ``axis_name`` (the shard_map'd fused pipeline), each shard computes
+    grads/metrics over its LOCAL batch slice and psums them: the loss is a
+    sum over batch elements, so the psum'd gradient equals the single-device
+    gradient of the full batch, and the (replicated) optimizer step — incl.
+    the global-norm clip, which must see the GLOBAL gradient — is identical
+    on every shard, keeping params replicated without a broadcast."""
     apply_fn = module.apply
 
     def init_hidden_for(batch):
@@ -62,6 +69,9 @@ def _update_core(module, cfg: LossConfig, optimizer):
             return compute_loss(apply_fn, params, init_hidden, batch, cfg)
 
         (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.psum(grads, axis_name)
+            aux = jax.lax.psum(aux, axis_name)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(state.params, updates)

@@ -849,11 +849,29 @@ class Learner:
                       % env_args['env'])
 
         # device-ingest layout (when the env/config allows assembling
-        # training windows on device, ops/device_windows.py)
+        # training windows on device, ops/device_windows.py). On a
+        # multi-device mesh only the fused pipeline runs device ingest
+        # (shard_map over 'data': per-shard envs + ring, gradient psum);
+        # the generation_envs/batch_size must divide the device count.
+        n_dev = len(self.trainer.mesh.devices.flat) \
+            if self.trainer.mesh is not None else 1
+        eval_envs = int(args.get('eval_envs')
+                        or max(4, args.get('generation_envs', 64) // 8))
+        mesh_fused_ok = (
+            self.trainer.mesh is None
+            or (args.get('fused_pipeline', True)
+                and args.get('generation_envs', 64) % n_dev == 0
+                and args['batch_size'] % n_dev == 0))
+        if self.trainer.mesh is not None and mesh_fused_ok \
+                and eval_envs % n_dev != 0:
+            # eval_envs is only a throughput knob — round it up to the mesh
+            # rather than silently disqualifying the sharded trainer
+            from .parallel.mesh import pad_to_multiple
+            eval_envs = pad_to_multiple(eval_envs, n_dev)
         ingest_mode = None
         if (env_mod is not None and args.get('device_replay')
                 and args.get('device_ingest', True)
-                and self.trainer.mesh is None):
+                and mesh_fused_ok):
             simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
             if simultaneous and not args['turn_based_training']:
                 ingest_mode = 'solo'
@@ -861,17 +879,21 @@ class Learner:
                   and not args['observation']):
                 ingest_mode = 'turn'
 
-        eval_envs = int(args.get('eval_envs')
-                        or max(4, args.get('generation_envs', 64) // 8))
         opponents = args.get('eval', {}).get('opponent', []) or ['random']
         if (env_mod is not None and set(opponents) == {'random'}
                 and args.get('device_eval', True)):
             # eval matches ride the accelerator too: the host evaluator's
             # one-dispatch-per-ply cost dominates chunked device generation
             from .device_generation import DeviceEvaluator
+            # shard eval envs only when the sharded fused trainer runs (its
+            # replicated actor params are what the eval program binds)
+            eval_mesh = (self.trainer.mesh
+                         if (self.trainer.mesh is not None
+                             and ingest_mode is not None) else None)
             evaluator = DeviceEvaluator(env_mod, actor, args,
                                         n_envs=eval_envs,
-                                        chunk_steps=chunk_steps)
+                                        chunk_steps=chunk_steps,
+                                        mesh=eval_mesh)
         else:
             evaluator = BatchedEvaluator(make_env_fn, actor, args,
                                          n_envs=eval_envs)
@@ -886,7 +908,9 @@ class Learner:
                 mode=mode, fs=args['forward_steps'],
                 bi=args['burn_in_steps'], max_steps=max_steps,
                 windows_cap=windows_cap,
-                capacity=self.trainer.replay.capacity,
+                # on a mesh each shard owns ring_capacity/n_dev rows; the
+                # global ring keeps the configured total budget
+                capacity=max(1, self.trainer.replay.capacity // n_dev),
                 num_players=env_mod.NUM_PLAYERS, gamma=args['gamma'],
                 has_reward=hasattr(env_mod, 'rewards'))
 
@@ -993,8 +1017,10 @@ class Learner:
         dispatch latency allows."""
         args = self.args
         tr = self.trainer
+        n_dev = len(tr.mesh.devices.flat) if tr.mesh is not None else 1
         print('fused device pipeline: rollout+ingest+train in one dispatch '
-              '(%s mode)' % mode)
+              '(%s mode%s)' % (mode, ', sharded over %d devices' % n_dev
+                               if tr.mesh is not None else ''))
         from .ops.fused_pipeline import FusedPipeline
         sgd_steps = int(args.get('sgd_steps_per_chunk') or 16)   # doc: config.py
         tr.windower = windower   # ring occupancy reporting
@@ -1003,7 +1029,8 @@ class Learner:
             n_envs=args.get('generation_envs', 64),
             chunk_steps=int(args.get('device_chunk_steps') or 16),
             sgd_steps=sgd_steps, batch_size=args['batch_size'],
-            default_lr=tr.default_lr, seed=args.get('seed', 0))
+            default_lr=tr.default_lr, seed=args.get('seed', 0),
+            mesh=tr.mesh)
 
         cadence = _EpochCadence(args)
         actor_epoch = self.model_epoch
@@ -1030,8 +1057,20 @@ class Learner:
         # no host round trip, and correct even on epochs where
         # checkpoint_interval skipped the host snapshot. A real copy (not an
         # alias) is required — the next fused dispatch donates tr.state.
-        copy_params = jax.jit(
-            lambda p: jax.tree_util.tree_map(jnp.copy, p))
+        if tr.mesh is not None:
+            # pin the replicated layout up front so dispatches never
+            # re-broadcast device-0 arrays across the mesh
+            from .parallel.mesh import replicated_sharding
+            repl = replicated_sharding(tr.mesh)
+            actor.params = jax.device_put(actor.params, repl)
+            if tr.state is not None:
+                tr.state = jax.device_put(tr.state, repl)
+            copy_params = jax.jit(
+                lambda p: jax.tree_util.tree_map(jnp.copy, p),
+                out_shardings=repl)
+        else:
+            copy_params = jax.jit(
+                lambda p: jax.tree_util.tree_map(jnp.copy, p))
 
         while not self.shutdown_flag:
             if self._deadline and time.time() >= self._deadline:
