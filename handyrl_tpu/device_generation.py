@@ -380,30 +380,63 @@ class DeviceGenerator:
 
 
 class DeviceEvaluator:
-    """Device-resident online evaluation vs the random opponent.
+    """Device-resident online evaluation vs a roster of opponents.
 
     The host BatchedEvaluator pays one inference dispatch per ply of every
     match; on a dispatch-latency-heavy backend that makes evaluation the
     dominant cost of the epoch loop (it needs ~10x more dispatches than
-    chunked device generation for the same ply count). When the opponent is
-    'random' (the reference's default, config.yaml eval.opponent) and the
-    env has a pure-JAX twin, the whole match runs on device instead: one
-    rotating seat per env plays the trained model greedily (the same
-    temperature-0 policy as BatchedEvaluator / reference agent.py Agent),
-    every other seat samples uniformly from its legal actions, and the host
-    receives only (done, outcome, seat) per ply — K plies of N matches per
-    program dispatch.
+    chunked device generation for the same ply count). When every opponent
+    is 'random' or a checkpoint path (league play) and the env has a
+    pure-JAX twin, the whole match runs on device instead: envs split into
+    one contiguous block per opponent, one rotating seat per env plays the
+    trained model greedily (the same temperature-0 policy as
+    BatchedEvaluator / reference agent.py Agent), the other seats either
+    sample uniformly ('random') or play their checkpoint's greedy policy —
+    inferenced inside the same compiled ply — and the host receives only
+    (done, outcome, seat) per ply, K plies of N matches per dispatch.
+    'rulebase' and model opponents for recurrent nets stay on the host
+    evaluator (train.py device_eval_ok).
     """
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
                  n_envs: int = 64, chunk_steps: int = 16, seed: int = 77,
-                 mesh=None):
+                 mesh=None, opponents=None):
         self.args = args
         self.chunk_steps = chunk_steps
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
         # one evaluated seat per env, rotated on every reset so first/second
         # (and every goose slot) are balanced like evaluate_mp's scheduler
         self.seat = jnp.arange(n_envs, dtype=jnp.int32) % env_mod.NUM_PLAYERS
+
+        # opponent roster: envs are split into one contiguous block per
+        # opponent (league play stays one-dispatch-per-chunk — the round-2
+        # device evaluator silently fell back to the per-ply host evaluator
+        # for anything but 'random'). 'random' plays uniform; a checkpoint
+        # path plays its own greedy policy, inferenced inside the same
+        # compiled ply. Recurrent opponents are refused at construction
+        # (the Learner falls back to the host evaluator for those).
+        self.opponents = [str(o) for o in (opponents or ['random'])]
+        assert n_envs >= len(self.opponents), \
+            'need at least one eval env per opponent'
+        self._opp_params: List[Any] = []
+        bounds = np.linspace(0, n_envs, len(self.opponents) + 1).astype(int)
+        self._opp_bounds = [(int(a), int(b), name)
+                            for a, b, name in zip(bounds[:-1], bounds[1:],
+                                                  self.opponents)]
+        self._env_opp = np.empty(n_envs, dtype=object)
+        for a, b, name in self._opp_bounds:
+            self._env_opp[a:b] = name
+        model_opps = [o for o in self.opponents if o != 'random']
+        if model_opps:
+            assert not self.recurrent, \
+                'device eval with model opponents needs a feedforward net'
+            # the trained wrapper's params are the ready-made template for
+            # msgpack deserialization (same module, same tree)
+            from flax import serialization
+            for path in model_opps:
+                with open(path, 'rb') as f:
+                    self._opp_params.append(jax.device_put(
+                        serialization.from_bytes(wrapper.params, f.read())))
         if mesh is not None:
             # eval envs sharded over 'data' alongside the fused trainer
             # (params arrive replicated); the plain-jit rollout partitions
@@ -422,8 +455,12 @@ class DeviceEvaluator:
         simultaneous = self.simultaneous
         recurrent = self.recurrent
 
+        opp_bounds = self._opp_bounds
+        model_ix = {name: i for i, name in enumerate(
+            o for o in self.opponents if o != 'random')}
+
         @jax.jit
-        def rollout(params, state, hidden, seat, rng):
+        def rollout(params, opp_params, state, hidden, seat, rng):
             def body(carry, _):
                 state, hidden, seat, rng = carry
                 obs, logits, amask, hidden, _ = _ply_inference(
@@ -431,13 +468,30 @@ class DeviceEvaluator:
                     params, state, hidden)
                 greedy = jnp.argmax(logits, axis=-1)
                 rng, key = jax.random.split(rng)
-                uniform = jax.random.categorical(key, -amask)
+                opp_act = jax.random.categorical(key, -amask)
+                # checkpoint-opponent blocks: their greedy policy on the
+                # same obs, traced into this one program (static slices)
+                for a, b, name in opp_bounds:
+                    if name == 'random' or a == b:
+                        continue
+                    pg = opp_params[model_ix[name]]
+                    o = obs[a:b]
+                    if simultaneous:
+                        No, Po = o.shape[:2]
+                        out_o = apply_fn(pg, o.reshape((No * Po,)
+                                                       + o.shape[2:]), None)
+                        lg = (out_o['policy'].reshape(No, Po, -1)
+                              - amask[a:b])
+                    else:
+                        out_o = apply_fn(pg, o, None)
+                        lg = out_o['policy'] - amask[a:b]
+                    opp_act = opp_act.at[a:b].set(jnp.argmax(lg, axis=-1))
                 if simultaneous:
                     P2 = logits.shape[1]
                     is_main = (jnp.arange(P2)[None, :] == seat[:, None])
                 else:
                     is_main = env_mod.turn(state) == seat
-                actions = jnp.where(is_main, greedy, uniform)
+                actions = jnp.where(is_main, greedy, opp_act)
                 nstate = env_mod.step(state, actions)
                 done = env_mod.terminal(nstate)
                 record = {'done': done, 'seat': seat,
@@ -462,7 +516,8 @@ class DeviceEvaluator:
     def _dispatch(self):
         """Dispatch a chunk + its packed (done, seat, outcome) fetchable."""
         self.state, self.hidden, self.seat, self.rng, records = \
-            self._rollout(self.wrapper.params, self.state, self.hidden,
+            self._rollout(self.wrapper.params, tuple(self._opp_params),
+                          self.state, self.hidden,
                           self.seat, self.rng)
         self.dispatches += 1
         records = dict(records)
@@ -498,7 +553,7 @@ class DeviceEvaluator:
                 'args': {'role': 'e', 'player': [seat],
                          'model_id': {p: (0 if p == seat else -1)
                                       for p in players}},
-                'opponent': 'random',
+                'opponent': self._env_opp[i],
                 'result': {p: float(outcomes[k, i, p]) for p in players},
             })
         return results

@@ -880,8 +880,25 @@ class Learner:
                 ingest_mode = 'turn'
 
         opponents = args.get('eval', {}).get('opponent', []) or ['random']
-        if (env_mod is not None and set(opponents) == {'random'}
-                and args.get('device_eval', True)):
+
+        def device_eval_ok():
+            """'random' and checkpoint opponents run on device; 'rulebase'
+            (host rules code) and model opponents for recurrent nets (their
+            hidden carry is not plumbed) use the host evaluator."""
+            if env_mod is None or not args.get('device_eval', True):
+                return False
+            if len(opponents) > eval_envs:   # every opponent needs an env
+                return False
+            for o in opponents:
+                if o == 'random':
+                    continue
+                if (isinstance(o, str) and os.path.exists(o)
+                        and not hasattr(actor.module, 'init_hidden')):
+                    continue
+                return False
+            return True
+
+        if device_eval_ok():
             # eval matches ride the accelerator too: the host evaluator's
             # one-dispatch-per-ply cost dominates chunked device generation
             from .device_generation import DeviceEvaluator
@@ -893,7 +910,8 @@ class Learner:
             evaluator = DeviceEvaluator(env_mod, actor, args,
                                         n_envs=eval_envs,
                                         chunk_steps=chunk_steps,
-                                        mesh=eval_mesh)
+                                        mesh=eval_mesh,
+                                        opponents=opponents)
         else:
             evaluator = BatchedEvaluator(make_env_fn, actor, args,
                                          n_envs=eval_envs)
