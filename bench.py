@@ -37,17 +37,26 @@ _CHILDREN = []
 METRIC = 'learner trajectories/sec (GeeseNet B=128 T=16, full update step)'
 UNIT = 'trajectories/sec'
 
-# bf16/fp32-with-MXU peak FLOP/s per chip by device_kind substring.
-# Public figures: v4 275T, v5e 197T, v5p 459T, v6e 918T (bf16).
-_PEAK_FLOPS = (
-    ('v6', 918e12),
-    ('v5p', 459e12),
-    ('v5 lite', 197e12),
-    ('v5e', 197e12),
-    ('v4', 275e12),
-    ('v3', 123e12),
-    ('v2', 45e12),
+# Per-chip peaks by device_kind substring: (key, bf16 FLOP/s, HBM bytes/s).
+# Public figures: v4 275T & 1.23TB/s, v5e 197T & 819GB/s, v5p 459T &
+# 2.77TB/s, v6e 918T & 1.64TB/s.
+_PEAKS = (
+    ('v6', 918e12, 1.64e12),
+    ('v5p', 459e12, 2.77e12),
+    ('v5 lite', 197e12, 819e9),
+    ('v5e', 197e12, 819e9),
+    ('v4', 275e12, 1.23e12),
+    ('v3', 123e12, 900e9),
+    ('v2', 45e12, 700e9),
 )
+
+
+def _peak(device_kind: str, column: int) -> float:
+    kind = device_kind.lower()
+    for row in _PEAKS:
+        if row[0] in kind:
+            return row[column]
+    return 0.0
 
 
 def emit(value=0.0, vs_baseline=0.0, **extra):
@@ -107,11 +116,11 @@ def probe_backend(deadline: float) -> dict:
 
 
 def peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return 0.0
+    return _peak(device_kind, 1)
+
+
+def peak_hbm_bw(device_kind: str) -> float:
+    return _peak(device_kind, 2)
 
 
 def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3,
@@ -133,16 +142,16 @@ def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3,
       holes; with chunk=5 the added round-trip latency is amortized to
       noise.
 
-    Returns (seconds_per_step, flops_per_step); flops come from XLA's own
-    cost analysis of the same executable, 0.0 if the AOT path is
-    unavailable.
+    Returns (seconds_per_step, flops_per_step, hbm_bytes_per_step); the
+    flop and byte counts come from XLA's own cost analysis of the same
+    executable, both 0.0 if the AOT path is unavailable.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     batch = jax.tree_util.tree_map(jnp.asarray, batch)
-    flops = 0.0
+    flops = hbm_bytes = 0.0
     try:
         compiled = step_fn.lower(state, batch, lr).compile()
     except Exception:
@@ -153,6 +162,7 @@ def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3,
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
             flops = float((cost or {}).get('flops', 0.0))
+            hbm_bytes = float((cost or {}).get('bytes accessed', 0.0))
         except Exception:
             pass   # keep the valid executable; flops stay unreported
 
@@ -172,7 +182,7 @@ def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3,
             state, metrics = compiled(state, batch, lr)
         sync(metrics)
         done += n
-    return (time.time() - t0) / steps, flops
+    return (time.time() - t0) / steps, flops, hbm_bytes
 
 
 def headline_setup(B=128, T=16, dtype=None, seed=0):
@@ -221,7 +231,9 @@ def run_bench(probe: dict):
     B, T = 128, 16
     steps = 30
 
-    module, cfg, batch, state = headline_setup(B, T)
+    # bf16 activations on the MXU (the learner's compute_dtype mode,
+    # tests/test_bf16.py); params and the optimizer stay float32
+    module, cfg, batch, state = headline_setup(B, T, dtype=jnp.bfloat16)
     devices = jax.devices()
     mesh = make_mesh(devices) if len(devices) > 1 else None
     step = build_update_step(module, cfg, mesh=mesh, donate=False)
@@ -229,7 +241,7 @@ def run_bench(probe: dict):
         batch = shard_batch(mesh, batch)
     lr = jnp.asarray(1e-5, jnp.float32)
 
-    sec_per_step, flops_per_step = time_compiled_step(
+    sec_per_step, flops_per_step, hbm_bytes_per_step = time_compiled_step(
         step, state, batch, lr, steps)
     dt = sec_per_step * steps
     traj_per_sec = B / sec_per_step
@@ -248,12 +260,20 @@ def run_bench(probe: dict):
     # denominator is the peak of every device it ran across
     peak = peak_flops(probe.get('device_kind', '')) * max(1, len(devices))
     mfu = (flops_per_step * steps / dt / peak) if peak else 0.0
+    # roofline: which wall does the step actually sit against? mbu is the
+    # fraction of peak HBM bandwidth the measured step sustains; whichever
+    # utilization is higher names the bound
+    bw = peak_hbm_bw(probe.get('device_kind', '')) * max(1, len(devices))
+    mbu = (hbm_bytes_per_step / sec_per_step / bw) if bw else 0.0
+    bound = ('hbm' if mbu >= mfu else 'mxu') if (mbu or mfu) else 'unknown'
     emit(traj_per_sec, vs_baseline,
          device=probe.get('device_kind', 'unknown'),
          backend=probe.get('backend', 'unknown'),
          step_ms=round(dt / steps * 1e3, 2),
          flops_per_step=flops_per_step,
-         mfu=round(mfu, 4))
+         hbm_bytes_per_step=hbm_bytes_per_step,
+         compute_dtype='bfloat16',
+         mfu=round(mfu, 4), mbu=round(mbu, 4), roofline_bound=bound)
 
 
 def main():

@@ -792,6 +792,17 @@ class Learner:
         if ev:
             n, r, _ = ev
             rec['win_rate'] = (r / (n + 1e-6) + 1) / 2
+        # fast runs see only a handful of eval games per epoch (an epoch can
+        # last ~2s); a trailing-window aggregate keeps the quality curve
+        # readable from the JSONL alone
+        recent = [self.results[e] for e in
+                  range(max(0, self.model_epoch - 10), self.model_epoch)
+                  if e in self.results]
+        if recent:
+            n = sum(t[0] for t in recent)
+            r = sum(t[1] for t in recent)
+            rec['win_rate_recent10'] = (r / (n + 1e-6) + 1) / 2
+            rec['eval_games_recent10'] = n
         if self.trainer.replay is not None:
             stats = self.trainer.replay_stats
             rec['replay_dropped_episodes'] = stats['dropped_episodes']

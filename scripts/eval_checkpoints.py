@@ -1,0 +1,96 @@
+"""Offline win-rate-vs-random curve from a model_dir of checkpoints.
+
+The online eval share samples too few games per epoch to draw a smooth
+quality curve for fast runs (an epoch lasts ~2s in the north-star config);
+this scores saved checkpoints directly with the DeviceEvaluator — whole
+matches on the accelerator, a few hundred games per point in seconds.
+
+Usage:
+  python scripts/eval_checkpoints.py MODEL_DIR ENV OUT.jsonl \
+      [--every N] [--games G] [--envs E]
+
+Writes one JSON line per checkpoint: {"epoch": N, "games": G, "win_rate":
+W, "mean": M} where win_rate = (mean outcome + 1) / 2 (the reference's
+normalization, train.py win-rate lines).
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+
+def main():
+    model_dir, env_name, out_path = sys.argv[1:4]
+    opts = sys.argv[4:]
+
+    def opt(name, default):
+        return int(opts[opts.index(name) + 1]) if name in opts else default
+
+    every = opt('--every', 5)
+    games = opt('--games', 192)
+    n_envs = opt('--envs', 64)
+
+    import numpy as np
+
+    import handyrl_tpu
+    handyrl_tpu.setup_compile_cache()
+    from handyrl_tpu.device_generation import DeviceEvaluator
+    from handyrl_tpu.environment import make_env, make_jax_env
+    from handyrl_tpu.model import ModelWrapper
+
+    env_args = {'env': env_name}
+    env = make_env(env_args)
+    env.reset()
+    env_mod = make_jax_env(env_args)
+    assert env_mod is not None, 'offline device eval needs a jax twin'
+    example = env.observation(env.players()[0])
+
+    ckpts = sorted(
+        int(m.group(1)) for f in os.listdir(model_dir)
+        if (m := re.match(r'^(\d+)\.ckpt$', f)))
+    picks = [e for i, e in enumerate(ckpts) if i % every == 0]
+    if ckpts and ckpts[-1] not in picks:
+        picks.append(ckpts[-1])
+    print('evaluating %d checkpoints of %d (every %d) from %s'
+          % (len(picks), len(ckpts), every, model_dir), flush=True)
+
+    wrapper = ModelWrapper(env.net())
+    args = {'eval': {'opponent': ['random']}}
+    # ONE evaluator reused across checkpoints: a fresh instance would
+    # re-trace its rollout program per checkpoint. After each params swap,
+    # a few chunks are discarded so games started under the previous
+    # checkpoint don't contaminate the point.
+    ev = None
+    with open(out_path, 'a') as out:
+        for epoch in picks:
+            with open(os.path.join(model_dir, '%d.ckpt' % epoch), 'rb') as f:
+                wrapper.load_params_bytes(f.read(), example)
+            from handyrl_tpu.utils.fetch import put_tree
+            wrapper.params = put_tree(wrapper.params)
+            if ev is None:
+                ev = DeviceEvaluator(env_mod, wrapper, args, n_envs=n_envs,
+                                     chunk_steps=32, seed=1009)
+            else:
+                # flush cross-checkpoint games: a full max-length episode
+                # plus the one pipelined chunk must drain before counting
+                max_steps = int(getattr(env_mod, 'MAX_STEPS', 256))
+                for _ in range(max_steps // 32 + 2):
+                    ev.step()
+            results = []
+            while len(results) < games:
+                results.extend(ev.step())
+            vals = [r['result'][r['args']['player'][0]] for r in results]
+            mean = float(np.mean(vals))
+            row = {'epoch': epoch, 'games': len(vals),
+                   'win_rate': round((mean + 1) / 2, 4),
+                   'mean': round(mean, 4)}
+            out.write(json.dumps(row) + '\n')
+            out.flush()
+            print(row, flush=True)
+
+
+if __name__ == '__main__':
+    main()

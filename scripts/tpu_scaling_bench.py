@@ -44,7 +44,8 @@ def main():
             B, T, dtype=jnp.bfloat16 if bf16 else None)
         if step_fn is None:
             step_fn = build_update_step(module, cfg, mesh=None, donate=False)
-        dt, flops = time_compiled_step(step_fn, state, batch, lr, steps)
+        dt, flops, _bytes = time_compiled_step(step_fn, state, batch, lr,
+                                               steps)
         row = {'row': 'tpu-scaling', 'device': dev.device_kind, 'B': B,
                'T': T, 'dtype': 'bfloat16' if bf16 else 'float32',
                'step_ms': round(dt * 1e3, 2),
