@@ -33,6 +33,17 @@ ROWS = {
                        # per ~136-episode chunk)
                        'sgd_steps_per_chunk': 192},
     },
+    # the sharded fused pipeline on a virtual 8-device CPU mesh (multichip
+    # evidence without multichip hardware): run with
+    #   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    'ttt-device-mesh8': {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'batch_size': 64, 'forward_steps': 8,
+                       'update_episodes': 200, 'minimum_episodes': 400,
+                       'generation_envs': 64, 'eval_envs': 32,
+                       'device_generation': True, 'device_replay': True,
+                       'sgd_steps_per_chunk': 192},
+    },
     'ttt-vtrace': {
         'env_args': {'env': 'TicTacToe'},
         'train_args': {'batch_size': 64, 'forward_steps': 8,
@@ -91,6 +102,11 @@ ROWS = {
 
 
 def run_row(name, epochs):
+    # honor an explicit operator platform choice under the axon site hook
+    plat = os.environ.get('JAX_PLATFORMS', '').strip()
+    if plat and plat != 'axon':
+        import jax
+        jax.config.update('jax_platforms', plat)
     from handyrl_tpu.config import apply_defaults
     from handyrl_tpu.train import Learner
 
