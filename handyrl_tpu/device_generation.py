@@ -394,8 +394,10 @@ class DeviceEvaluator:
     sample uniformly ('random') or play their checkpoint's greedy policy —
     inferenced inside the same compiled ply — and the host receives only
     (done, outcome, seat) per ply, K plies of N matches per dispatch.
-    'rulebase' and model opponents for recurrent nets stay on the host
-    evaluator (train.py device_eval_ok).
+    'rulebase' also runs on device when the env twin vectorizes its agent
+    (``greedy_action``, e.g. jax_hungry_geese); otherwise it and model
+    opponents for recurrent nets stay on the host evaluator
+    (train.py device_eval_ok).
     """
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
@@ -426,7 +428,11 @@ class DeviceEvaluator:
         self._env_opp = np.empty(n_envs, dtype=object)
         for a, b, name in self._opp_bounds:
             self._env_opp[a:b] = name
-        model_opps = [o for o in self.opponents if o != 'random']
+        if 'rulebase' in self.opponents:
+            assert hasattr(env_mod, 'greedy_action'), \
+                'device rulebase eval needs the env twin to vectorize it'
+        model_opps = [o for o in self.opponents
+                      if o not in ('random', 'rulebase')]
         if model_opps:
             assert not self.recurrent, \
                 'device eval with model opponents needs a feedforward net'
@@ -457,7 +463,8 @@ class DeviceEvaluator:
 
         opp_bounds = self._opp_bounds
         model_ix = {name: i for i, name in enumerate(
-            o for o in self.opponents if o != 'random')}
+            o for o in self.opponents if o not in ('random', 'rulebase'))}
+        any_rulebase = any(name == 'rulebase' for _, _, name in opp_bounds)
 
         @jax.jit
         def rollout(params, opp_params, state, hidden, seat, rng):
@@ -469,10 +476,17 @@ class DeviceEvaluator:
                 greedy = jnp.argmax(logits, axis=-1)
                 rng, key = jax.random.split(rng)
                 opp_act = jax.random.categorical(key, -amask)
-                # checkpoint-opponent blocks: their greedy policy on the
-                # same obs, traced into this one program (static slices)
+                if any_rulebase:   # the env's vectorized rulebase agent
+                    rng, rkey = jax.random.split(rng)
+                    rule_act = env_mod.greedy_action(state, rkey)
+                # opponent blocks: checkpoint policies (greedy) and the
+                # rulebase agent, traced into this one program (static
+                # slices)
                 for a, b, name in opp_bounds:
                     if name == 'random' or a == b:
+                        continue
+                    if name == 'rulebase':
+                        opp_act = opp_act.at[a:b].set(rule_act[a:b])
                         continue
                     pg = opp_params[model_ix[name]]
                     o = obs[a:b]

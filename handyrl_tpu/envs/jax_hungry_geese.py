@@ -209,6 +209,83 @@ def step(state: State, actions: jnp.ndarray) -> State:
                  steps=steps, scores=scores, key=key)
 
 
+GREEDY_ORDER = jnp.array([0, 3, 1, 2], jnp.int32)   # kaggle Action order
+
+
+def greedy_action(state: State, key) -> jnp.ndarray:
+    """Vectorized GreedyAgent (N, P): the kaggle rulebase opponent the
+    reference delegates to, same decision rules as the host port
+    (envs/kaggle/hungry_geese.py rule_based_action): candidates may not
+    reverse, land on a cell adjacent to an opponent head, on any non-tail
+    goose cell, or on the tail of an opponent about to eat; among
+    candidates, minimum NON-wrapped Manhattan distance to the nearest
+    food, ties in kaggle Action order NORTH, EAST, SOUTH, WEST; no
+    candidate -> uniform random over all four actions."""
+    N = state.cells.shape[0]
+    heads = state.cells[:, :, 0]                             # (N, P)
+    idx = jnp.arange(MAX_LEN)[None, None, :]
+
+    # move targets for every (player, action)
+    targets = _move_cells(heads[:, :, None],
+                          jnp.arange(4)[None, None, :])      # (N, P, 4)
+
+    # bodies of ALL geese excluding each goose's tail cell
+    body_valid = (idx < (state.length - 1)[..., None]) & state.alive[..., None]
+    body_flat = jnp.where(body_valid, state.cells, N_CELLS)
+    bodies = jax.nn.one_hot(body_flat, N_CELLS + 1,
+                            dtype=bool).any(axis=(1, 2))[:, :N_CELLS]  # (N,77)
+
+    # per-source adjacency of each goose's head (only alive geese) — the
+    # same four neighbor cells as the move targets above
+    head_adj = jnp.where(state.alive[..., None], targets, N_CELLS)
+    adj_src = jax.nn.one_hot(head_adj, N_CELLS + 1,
+                             dtype=bool).any(axis=2)[..., :N_CELLS]  # (N,P,77)
+    # viewer p bans cells adjacent to OPPONENT heads only
+    others_adj = jnp.stack(
+        [(adj_src[:, [q for q in range(NUM_PLAYERS) if q != p]]).any(axis=1)
+         for p in range(NUM_PLAYERS)], axis=1)               # (N, P, 77)
+
+    # tails of geese about to eat (head adjacent to food)
+    food_mask = jax.nn.one_hot(state.food, N_CELLS,
+                               dtype=bool).any(axis=1)       # (N, 77)
+    eats_next = (adj_src & food_mask[:, None, :]).any(axis=2)  # (N, P)
+    tail_ix = jnp.clip(state.length - 1, 0, MAX_LEN - 1)
+    tails = jnp.take_along_axis(state.cells, tail_ix[..., None],
+                                axis=2)[..., 0]              # (N, P)
+    tails = jnp.where(state.alive & eats_next, tails, N_CELLS)
+    tail_src = jax.nn.one_hot(tails, N_CELLS + 1,
+                              dtype=bool)[..., :N_CELLS]     # (N, P, 77)
+    others_eating_tails = jnp.stack(
+        [(tail_src[:, [q for q in range(NUM_PLAYERS) if q != p]]).any(axis=1)
+         for p in range(NUM_PLAYERS)], axis=1)               # (N, P, 77)
+
+    banned_mask = others_adj | others_eating_tails | bodies[:, None, :]
+    hit = jnp.take_along_axis(
+        banned_mask.reshape(N * NUM_PLAYERS, N_CELLS),
+        targets.reshape(N * NUM_PLAYERS, 4), axis=1
+    ).reshape(N, NUM_PLAYERS, 4)
+    reverse = (state.last_action[..., None] >= 0) & (
+        jnp.arange(4)[None, None, :]
+        == OPPOSITE[jnp.clip(state.last_action, 0, 3)][..., None])
+    allowed = ~(hit | reverse)                               # (N, P, 4)
+
+    # NON-wrapped Manhattan distance to nearest food from each target
+    tr, tc = targets // C, targets % C                       # (N, P, 4)
+    fr, fc = state.food // C, state.food % C                 # (N, F)
+    dist = (jnp.abs(tr[..., None] - fr[:, None, None, :])
+            + jnp.abs(tc[..., None] - fc[:, None, None, :])).min(axis=-1)
+
+    # min dist among allowed, ties in GREEDY_ORDER; the rank term is < 1
+    # so it never outweighs a distance difference
+    rank = jnp.argsort(GREEDY_ORDER)                         # action -> rank
+    score = jnp.where(allowed, dist.astype(jnp.float32)
+                      + rank[None, None, :].astype(jnp.float32) / 8.0,
+                      jnp.inf)
+    best = jnp.argmin(score, axis=-1).astype(jnp.int32)      # (N, P)
+    fallback = jax.random.randint(key, (N, NUM_PLAYERS), 0, 4, jnp.int32)
+    return jnp.where(allowed.any(axis=-1), best, fallback)
+
+
 def observe(state: State) -> jnp.ndarray:
     """Per-player observation planes (N, P, 17, 7, 11), channel layout and
     relative player rotation exactly as the host env (hungry_geese.py

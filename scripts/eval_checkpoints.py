@@ -1,4 +1,4 @@
-"""Offline win-rate-vs-random curve from a model_dir of checkpoints.
+"""Offline win-rate curve from a model_dir of checkpoints.
 
 The online eval share samples too few games per epoch to draw a smooth
 quality curve for fast runs (an epoch lasts ~2s in the north-star config);
@@ -7,11 +7,11 @@ matches on the accelerator, a few hundred games per point in seconds.
 
 Usage:
   python scripts/eval_checkpoints.py MODEL_DIR ENV OUT.jsonl \
-      [--every N] [--games G] [--envs E]
+      [--every N] [--games G] [--envs E] [--opponent random|rulebase|CKPT]
 
-Writes one JSON line per checkpoint: {"epoch": N, "games": G, "win_rate":
-W, "mean": M} where win_rate = (mean outcome + 1) / 2 (the reference's
-normalization, train.py win-rate lines).
+Writes one JSON line per checkpoint: {"epoch": N, "opponent": O,
+"games": G, "win_rate": W, "mean": M} where win_rate = (mean outcome+1)/2
+(the reference's normalization, train.py win-rate lines).
 """
 
 import json
@@ -32,6 +32,8 @@ def main():
     every = opt('--every', 5)
     games = opt('--games', 192)
     n_envs = opt('--envs', 64)
+    opponent = (opts[opts.index('--opponent') + 1]
+                if '--opponent' in opts else 'random')
 
     import numpy as np
 
@@ -58,7 +60,7 @@ def main():
           % (len(picks), len(ckpts), every, model_dir), flush=True)
 
     wrapper = ModelWrapper(env.net())
-    args = {'eval': {'opponent': ['random']}}
+    args = {'eval': {'opponent': [opponent]}}
     # ONE evaluator reused across checkpoints: a fresh instance would
     # re-trace its rollout program per checkpoint. After each params swap,
     # a few chunks are discarded so games started under the previous
@@ -72,7 +74,8 @@ def main():
             wrapper.params = put_tree(wrapper.params)
             if ev is None:
                 ev = DeviceEvaluator(env_mod, wrapper, args, n_envs=n_envs,
-                                     chunk_steps=32, seed=1009)
+                                     chunk_steps=32, seed=1009,
+                                     opponents=[opponent])
             else:
                 # flush cross-checkpoint games: a full max-length episode
                 # plus the one pipelined chunk must drain before counting
@@ -84,7 +87,8 @@ def main():
                 results.extend(ev.step())
             vals = [r['result'][r['args']['player'][0]] for r in results]
             mean = float(np.mean(vals))
-            row = {'epoch': epoch, 'games': len(vals),
+            row = {'epoch': epoch, 'opponent': opponent,
+                   'games': len(vals),
                    'win_rate': round((mean + 1) / 2, 4),
                    'mean': round(mean, 4)}
             out.write(json.dumps(row) + '\n')

@@ -124,3 +124,26 @@ def test_model_opponent_differs_from_random():
             per_seat.setdefault(seat, set()).add(r['result'][seat])
         for seat, outs in per_seat.items():
             assert len(outs) == 1, (seat, outs)
+
+
+def test_geese_rulebase_opponent_on_device():
+    """The vectorized GreedyAgent plays the opponent seats on device; the
+    untrained net should score clearly WORSE vs rulebase than vs random."""
+    obs = np.zeros((1, 17, 7, 11), np.float32)
+    w = _wrapper(build('GeeseNet', layers=2, filters=8), obs)
+
+    def run(opp, n=48):
+        ev = DeviceEvaluator(jax_hungry_geese, w, {}, n_envs=16,
+                             chunk_steps=32, opponents=[opp])
+        results = []
+        while len(results) < n:
+            results.extend(ev.step())
+        vals = [r['result'][r['args']['player'][0]] for r in results]
+        for r in results:
+            assert r['opponent'] == opp
+        return float(np.mean(vals))
+
+    vs_random = run('random')
+    vs_rule = run('rulebase')
+    # untrained vs 3 greedy geese must be clearly below its vs-random score
+    assert vs_rule < vs_random - 0.2, (vs_rule, vs_random)

@@ -250,3 +250,56 @@ def test_differential_fuzz_host_vs_jax(seed):
             dev_out = list(np.asarray(jhg.outcome(dev))[0])
             assert host_out == pytest.approx(dev_out), (episodes,)
     assert total_steps >= 2600
+
+
+def test_jax_greedy_agrees_with_host_rulebase():
+    """The vectorized device GreedyAgent must choose the SAME action as the
+    host behavioral port on every state where the host pick is not the
+    random fallback (fallbacks draw from different PRNGs)."""
+    from handyrl_tpu.envs.kaggle.hungry_geese import (
+        GREEDY_ACTION_ORDER, OPPOSITE as HOST_OPP, _move)
+
+    rng = np.random.RandomState(7)
+    step_fn = jax.jit(jhg.step)
+    greedy_fn = jax.jit(jhg.greedy_action)
+    checked = agreed = 0
+    for ep in range(12):
+        host = HostGeese({'id': int(rng.randint(1 << 30))})
+        dev = _manual_state([list(g) for g in host.geese], list(host.food))
+        while not host.terminal() and checked < 400:
+            dev_acts = np.asarray(greedy_fn(dev, jax.random.PRNGKey(
+                rng.randint(1 << 30))))[0]
+            for p in host.turns():
+                # detect the host fallback (no legal candidate) by
+                # re-deriving the candidate set per the documented rules
+                goose = host.geese[p]
+                opp = [g for q, g in enumerate(host.geese)
+                       if q != p and g]
+                head_adj = {_move(g[0], a) for g in opp for a in range(4)}
+                bodies = {c for g in host.geese for c in g[:-1]}
+                eat_tails = {g[-1] for g in opp
+                             if any(_move(g[0], a) in host.food
+                                    for a in range(4))}
+                last = host.last_actions.get(p)
+                banned = HOST_OPP[last] if last is not None else None
+                cands = [a for a in GREEDY_ACTION_ORDER
+                         if a != banned
+                         and _move(goose[0], a) not in head_adj
+                         and _move(goose[0], a) not in bodies
+                         and _move(goose[0], a) not in eat_tails]
+                if not cands:
+                    continue            # both sides fall back randomly
+                host_a = host.rule_based_action(p)
+                checked += 1
+                agreed += int(host_a == int(dev_acts[p]))
+                assert host_a == int(dev_acts[p]), (ep, p, host.geese,
+                                                    host.food, cands)
+            acts = {p: int(rng.randint(4)) for p in host.turns()}
+            host.step(dict(acts))
+            dev = step_fn(dev, jnp.asarray([[acts.get(p, 0)
+                                             for p in range(4)]]))
+            if len(host.food) < jhg.N_FOOD:
+                break
+            dev = dev._replace(food=jnp.asarray([list(host.food)],
+                                                jnp.int32))
+    assert checked >= 200 and agreed == checked
