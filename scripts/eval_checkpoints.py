@@ -35,6 +35,12 @@ def main():
     opponent = (opts[opts.index('--opponent') + 1]
                 if '--opponent' in opts else 'random')
 
+    # honor an explicit operator platform choice under the axon site hook
+    plat = os.environ.get('JAX_PLATFORMS', '').strip()
+    if plat and plat != 'axon':
+        import jax
+        jax.config.update('jax_platforms', plat)
+
     import numpy as np
 
     import handyrl_tpu
