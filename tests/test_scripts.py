@@ -95,3 +95,22 @@ def test_plot_parsers(tmp_path):
 
     stats = stats_plot.parse(str(log))
     assert stats == [(0.1, 0.9), (0.15, 0.8)]
+
+
+def test_eval_checkpoints_script(trained_models, monkeypatch, tmp_path):
+    """Offline checkpoint quality curve: one JSON row per checkpoint with a
+    win rate from whole-match device evaluation."""
+    import json
+
+    import eval_checkpoints
+    out = str(tmp_path / 'curve.jsonl')
+    monkeypatch.setattr(sys, 'argv',
+                        ['eval_checkpoints.py', trained_models, 'TicTacToe',
+                         out, '--every', '1', '--games', '12',
+                         '--envs', '4'])
+    eval_checkpoints.main()
+    rows = [json.loads(l) for l in open(out)]
+    assert [r['epoch'] for r in rows] == [1, 2]
+    for r in rows:
+        assert r['games'] >= 12 and 0.0 <= r['win_rate'] <= 1.0
+        assert r['opponent'] == 'random'
