@@ -41,6 +41,13 @@ def test_learner_with_device_replay(tmp_path):
         assert rec['replay_dropped_episodes'] >= 0
         assert 0.0 <= rec['replay_ring_occupancy'] <= 1.0
         assert rec['replay_sample_reuse'] >= 0.0
+    # the trailing-window eval aggregate appears once any eval games have
+    # resolved, and is a well-formed rate over a positive game count
+    recent = [r for r in records if 'win_rate_recent10' in r]
+    assert recent, 'expected trailing-window eval aggregate in metrics'
+    for rec in recent:
+        assert 0.0 <= rec['win_rate_recent10'] <= 1.0
+        assert rec['eval_games_recent10'] > 0
     last = records[-1]
     stats = learner.trainer.replay_stats
     assert stats['windows_ingested'] > 0
