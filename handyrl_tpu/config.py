@@ -46,6 +46,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
     'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)
     'replay_fused_steps': 8,      # SGD steps fused into one device program in device_replay mode
+    'max_sample_reuse': None,     # device_replay threaded trainer: cap samples-drawn / windows-ingested (None = free-spin like the reference)
     'fused_pipeline': True,       # one dispatch = rollout chunk + ingest + K SGD steps (device_ingest configs)
     'sgd_steps_per_chunk': None,  # fused-pipeline SGD steps per rollout chunk (pins the replay ratio); None = 16
     'checkpoint_interval': 1,     # fused loop: write model/trainer ckpt files every N epochs (params still refresh on device every epoch; a final flush always lands on shutdown)
@@ -103,4 +104,7 @@ def validate(args: Dict[str, Any]) -> None:
     assert ta['compress_steps'] >= 1
     assert 0.0 <= ta['eval_rate'] <= 1.0
     assert ta['batch_size'] >= 1
+    if ta.get('max_sample_reuse') is not None:
+        assert float(ta['max_sample_reuse']) > 0, \
+            'max_sample_reuse must be > 0 (unset it to free-spin)'
     assert 'env' in args['env_args'], 'env_args.env is required'

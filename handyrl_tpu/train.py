@@ -328,6 +328,22 @@ class Trainer:
                     buffers = self.replay.buffers
                     size = jnp.asarray(self.replay.size, jnp.int32)
                     cursor = jnp.asarray(self.replay.cursor, jnp.int32)
+                # optional replay-ratio cap: the threaded trainer otherwise
+                # free-spins as fast as dispatch allows (implicit, hardware-
+                # dependent reuse — the reference's behavior); with
+                # max_sample_reuse the trainer waits for fresh windows once
+                # samples-drawn / windows-ingested would exceed the cap,
+                # pinning off-policyness to a known ratio
+                cap = self.args.get('max_sample_reuse')
+                if cap and not self.update_flag:
+                    # never throttle an epoch that is waiting to close: the
+                    # loop must make >=1 dispatch per epoch to hand back
+                    drawn_next = (self.replay_stats['samples_drawn']
+                                  + self.args['batch_size'] * self.fused_steps)
+                    if drawn_next > float(cap) * max(
+                            1, self.replay_stats['windows_ingested']):
+                        time.sleep(0.05)
+                        continue
                 self.state, self._sample_key, metrics = self.replay_update(
                     self.state, buffers, self._sample_key, size, cursor,
                     jnp.asarray(self.data_cnt_ema, jnp.float32))
@@ -336,7 +352,11 @@ class Trainer:
                 pending_metrics.append(metrics)
                 batch_cnt += self.fused_steps
                 self.steps += self.fused_steps
-                if len(pending_metrics) >= 4:
+                # drain every 4 dispatches (a fetch costs a device sync) —
+                # but immediately when an epoch is waiting to close, so the
+                # close needs ONE dispatch, not four (matters when
+                # max_sample_reuse throttles the loop)
+                if len(pending_metrics) >= 4 or self.update_flag:
                     data_cnt += self._drain_metrics(pending_metrics)
                     pending_metrics = []
                 if 0 <= profile_stop_at <= self.steps:
@@ -405,9 +425,12 @@ class Trainer:
                                      self._ring_cursor, self._ring_size,
                                      self._ingest_key)
             self._pending_ingest.append(n_win)
-        # fetch window counts lazily; the startup gate needs a real sync
+        # fetch window counts lazily; the startup gate needs a real sync,
+        # and a configured reuse cap needs a CURRENT windows_ingested or it
+        # over-throttles by the un-flushed backlog
         if self._pending_ingest and (not self._ring_ready
-                                     or len(self._pending_ingest) >= 8):
+                                     or len(self._pending_ingest) >= 8
+                                     or self.args.get('max_sample_reuse')):
             total = int(sum(int(x) for x in self._pending_ingest))
             self._pending_ingest = []
             self.replay_stats['windows_ingested'] += total
@@ -1054,6 +1077,10 @@ class Learner:
               '(%s mode%s)' % (mode, ', sharded over %d devices' % n_dev
                                if tr.mesh is not None else ''))
         from .ops.fused_pipeline import FusedPipeline
+        if args.get('max_sample_reuse'):
+            print('note: max_sample_reuse applies to the threaded replay '
+                  'trainer; the fused pipeline pins reuse via '
+                  'sgd_steps_per_chunk instead')
         sgd_steps = int(args.get('sgd_steps_per_chunk') or 16)   # doc: config.py
         tr.windower = windower   # ring occupancy reporting
         fp = FusedPipeline(

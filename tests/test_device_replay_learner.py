@@ -73,3 +73,34 @@ def test_learner_with_host_push_device_replay(tmp_path):
     assert learner.model_epoch == 2
     assert learner.trainer.replay.size > 0
     assert learner.trainer.steps > 0
+
+
+def test_max_sample_reuse_caps_replay_ratio(tmp_path):
+    """With max_sample_reuse the threaded replay trainer waits for fresh
+    windows instead of free-spinning; the audited reuse stays at the cap."""
+    metrics_path = tmp_path / 'metrics.jsonl'
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 16, 'update_episodes': 40, 'minimum_episodes': 40,
+            'epochs': 3, 'generation_envs': 16, 'forward_steps': 8,
+            'num_batchers': 1, 'device_generation': True,
+            'device_replay': True, 'device_ingest': False,
+            'max_sample_reuse': 2.0,
+            'model_dir': str(tmp_path / 'models'),
+            'metrics_jsonl': str(metrics_path),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw))
+    learner.run()
+    assert learner.trainer.steps > 0
+    records = [json.loads(line) for line in
+               metrics_path.read_text().splitlines()]
+    # the final audited ratio respects the cap (one in-flight fused
+    # dispatch of slack at most)
+    final = records[-1]['replay_sample_reuse']
+    # slack: the cap never throttles an epoch waiting to close, so up to
+    # one fused dispatch per epoch may land above it
+    slack = 3 * 16 * learner.trainer.fused_steps / max(
+        1, learner.trainer.replay_stats['windows_ingested'])
+    assert final <= 2.0 + slack + 1e-6, (final, slack)
