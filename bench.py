@@ -285,7 +285,9 @@ def main():
 
     probe = probe_backend(min(120.0, deadline / 3))
     if 'error' in probe:
-        emit(error='backend unavailable: ' + probe['error'])
+        emit(error='backend unavailable: ' + probe['error'],
+             note='last measured TPU v5e value for this metric is in '
+                  'BENCHMARKS.md / benchmarks.jsonl (bf16 row)')
         return
     try:
         run_bench(probe)
