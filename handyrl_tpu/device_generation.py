@@ -228,6 +228,8 @@ class DeviceGenerator:
     one-chunk delay in episode accounting, nothing else.
     """
 
+    pipelined = True    # step_chunk* returns the PREVIOUS dispatch's chunk
+
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
                  n_envs: int = 256, chunk_steps: int = 16, seed: int = 0):
         self.args = args

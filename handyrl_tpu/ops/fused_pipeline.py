@@ -41,7 +41,8 @@ import numpy as np
 from ..device_generation import _init_rollout_engine, make_gen_body
 from .losses import LossConfig
 from .replay import recency_slots
-from .train_step import TrainState, _update_core, make_optimizer
+from .train_step import (TrainState, _update_core, init_train_state,
+                         make_optimizer)
 
 
 class FusedPipeline:
@@ -103,7 +104,19 @@ class FusedPipeline:
             self._shard_loop_state(mesh)
 
         self.num_players = int(env_mod.NUM_PLAYERS)
-        self._metric_keys: list = []   # filled at trace time, static order
+        # metric key order is part of the packed-fetch wire format; derive
+        # it statically from the update's abstract aux (no device work, no
+        # trace-order dependence)
+        probe_update = _update_core(wrapper.module, cfg, make_optimizer())
+
+        def _probe(params):
+            batch = {k: jnp.zeros((batch_size,) + shape, dtype)
+                     for k, (shape, dtype) in windower.window_spec.items()}
+            ts = init_train_state(params)
+            _, metrics = probe_update(ts, batch, jnp.float32(0.0))
+            return metrics
+        self._metric_keys: list = sorted(
+            jax.eval_shape(_probe, wrapper.params))
 
         def gen_ingest(actor_params, env_state, hidden, wstate, ring,
                        cursor, size, rng):
@@ -112,15 +125,17 @@ class FusedPipeline:
             (wstate, ring, cursor, size, rng,
              n_done, n_win) = ingest(records, wstate, ring, cursor, size, rng)
             return (env_state, hidden, wstate, ring, cursor, size, rng,
-                    records['done'], records['outcome'])
+                    records['done'], records['outcome'], n_win)
 
-        def pack(done, outcome, size, metric_vals):
+        def pack(done, outcome, size, size_min, n_win, metric_vals):
             # EVERYTHING the host reads per chunk rides ONE f32 array: a
             # distinct-array fetch costs a full tunnel round trip (~140 ms
             # measured), so one sync point per dispatch is the budget
             parts = [done.astype(jnp.float32).reshape(-1),
                      outcome.astype(jnp.float32).reshape(-1),
-                     size.astype(jnp.float32).reshape(1)]
+                     size.astype(jnp.float32).reshape(1),
+                     size_min.astype(jnp.float32).reshape(1),
+                     n_win.astype(jnp.float32).reshape(1)]
             parts += [v.astype(jnp.float32).reshape(1) for v in metric_vals]
             return jnp.concatenate(parts)
 
@@ -146,31 +161,31 @@ class FusedPipeline:
                 body, (train_state, rng), None, length=sgd_steps)
             metrics = jax.tree_util.tree_map(
                 lambda m: jnp.sum(m, axis=0), stacked)
-            keys = sorted(metrics)         # static: recorded at trace time
-            self._metric_keys[:] = keys
-            return train_state, rng, [metrics[k] for k in keys]
+            return train_state, rng, [metrics[k]
+                                      for k in self._metric_keys]
 
         if mesh is None:
             def warmup(actor_params, env_state, hidden, wstate, ring,
                        cursor, size, rng):
                 (env_state, hidden, wstate, ring, cursor, size, rng,
-                 done, outcome) = gen_ingest(
+                 done, outcome, n_win) = gen_ingest(
                     actor_params, env_state, hidden, wstate, ring, cursor,
                     size, rng)
                 return (env_state, hidden, wstate, ring, cursor, size, rng,
-                        pack(done, outcome, size, []))
+                        pack(done, outcome, size, size, n_win, []))
 
             def fused(actor_params, train_state: TrainState, env_state,
                       hidden, wstate, ring, cursor, size, rng, data_cnt_ema):
                 (env_state, hidden, wstate, ring, cursor, size, rng,
-                 done, outcome) = gen_ingest(
+                 done, outcome, n_win) = gen_ingest(
                     actor_params, env_state, hidden, wstate, ring, cursor,
                     size, rng)
                 train_state, rng, mvals = sgd_tail(
                     train_state, ring, cursor, size, rng, data_cnt_ema,
                     batch_size)
                 return (train_state, env_state, hidden, wstate, ring, cursor,
-                        size, rng, pack(done, outcome, size, mvals))
+                        size, rng,
+                        pack(done, outcome, size, size, n_win, mvals))
         else:
             warmup, fused = self._build_sharded(
                 mesh, gen_ingest, sgd_tail, pack, b_loc)
@@ -183,6 +198,8 @@ class FusedPipeline:
                               donate_argnums=tuple(range(1, 10)))
         self._pending = None   # (pack_future, has_metrics), one deep
         self.ring_size_host = 0
+        self.ring_min_host = 0          # min ring size across shards
+        self.windows_ingested_host = 0  # cumulative windows ingested
 
     # -- multi-chip construction -------------------------------------------
     def _shard_loop_state(self, mesh):
@@ -221,55 +238,61 @@ class FusedPipeline:
         def shard_warm(actor_params, env_state, hidden, wstate, ring,
                        cursor, size, rng):
             (env_state, hidden, wstate, ring, c, s, k,
-             done, outcome) = gen_ingest(
+             done, outcome, n_win) = gen_ingest(
                 actor_params, env_state, hidden, wstate, ring,
                 cursor[0], size[0], rng[0])
             size_tot = jax.lax.psum(s, 'data')
+            size_min = jax.lax.pmin(s, 'data')
+            win_tot = jax.lax.psum(n_win, 'data')
             return (env_state, hidden, wstate, ring, c[None], s[None],
-                    k[None], done, outcome, size_tot)
+                    k[None], done, outcome, size_tot, size_min, win_tot)
 
         def shard_fused(actor_params, train_state, env_state, hidden,
                         wstate, ring, cursor, size, rng, data_cnt_ema):
             (env_state, hidden, wstate, ring, c, s, k,
-             done, outcome) = gen_ingest(
+             done, outcome, n_win) = gen_ingest(
                 actor_params, env_state, hidden, wstate, ring,
                 cursor[0], size[0], rng[0])
             train_state, k, mvals = sgd_tail(
                 train_state, ring, c, s, k, data_cnt_ema, b_loc)
             size_tot = jax.lax.psum(s, 'data')
+            size_min = jax.lax.pmin(s, 'data')
+            win_tot = jax.lax.psum(n_win, 'data')
             return (train_state, env_state, hidden, wstate, ring, c[None],
-                    s[None], k[None], done, outcome, size_tot,
-                    jnp.stack(mvals) if mvals else jnp.zeros((0,)))
+                    s[None], k[None], done, outcome, size_tot, size_min,
+                    win_tot, jnp.stack(mvals) if mvals else jnp.zeros((0,)))
 
         sm_warm = shard_map(
             shard_warm, mesh=mesh,
             in_specs=(R, D, D, D, D, D, D, D),
             out_specs=(D, D, D, D, D, D, D, P(None, 'data'),
-                       P(None, 'data'), R))
+                       P(None, 'data'), R, R, R))
         sm_fused = shard_map(
             shard_fused, mesh=mesh,
             in_specs=(R, R, D, D, D, D, D, D, D, R),
             out_specs=(R, D, D, D, D, D, D, D, P(None, 'data'),
-                       P(None, 'data'), R, R))
+                       P(None, 'data'), R, R, R, R))
 
         def warmup(actor_params, env_state, hidden, wstate, ring,
                    cursor, size, rng):
             (env_state, hidden, wstate, ring, cursor, size, rng,
-             done, outcome, size_tot) = sm_warm(
+             done, outcome, size_tot, size_min, win_tot) = sm_warm(
                 actor_params, env_state, hidden, wstate, ring, cursor,
                 size, rng)
             return (env_state, hidden, wstate, ring, cursor, size, rng,
-                    pack(done, outcome, size_tot, []))
+                    pack(done, outcome, size_tot, size_min, win_tot, []))
 
         def fused(actor_params, train_state, env_state, hidden, wstate,
                   ring, cursor, size, rng, data_cnt_ema):
             (train_state, env_state, hidden, wstate, ring, cursor, size,
-             rng, done, outcome, size_tot, mvec) = sm_fused(
+             rng, done, outcome, size_tot, size_min, win_tot,
+             mvec) = sm_fused(
                 actor_params, train_state, env_state, hidden, wstate,
                 ring, cursor, size, rng, data_cnt_ema)
             mvals = [mvec[i] for i in range(len(self._metric_keys))]
             return (train_state, env_state, hidden, wstate, ring, cursor,
-                    size, rng, pack(done, outcome, size_tot, mvals))
+                    size, rng,
+                    pack(done, outcome, size_tot, size_min, win_tot, mvals))
 
         return warmup, fused
 
@@ -282,10 +305,14 @@ class FusedPipeline:
         outcome = flat[K * N:K * N * (1 + P)].reshape(K, N, P)
         rest = flat[K * N * (1 + P):]
         self.ring_size_host = int(rest[0])
+        self.ring_min_host = int(rest[1])
+        # true cumulative ingest count (ring size saturates at capacity
+        # once the ring wraps, so it cannot stand in for this)
+        self.windows_ingested_host += int(rest[2])
         metrics = None
         if has_metrics:
             metrics = {k: float(v)
-                       for k, v in zip(self._metric_keys, rest[1:])}
+                       for k, v in zip(self._metric_keys, rest[3:])}
         return {'done': done, 'outcome': outcome, 'metrics': metrics}
 
     def _flip(self, pack_future, has_metrics):

@@ -1037,7 +1037,12 @@ class Learner:
                     except queue.Full:
                         continue
             else:
-                stamp_and_feed(gen.step(), chunk_epoch)
+                # pipelined generators return the PREVIOUS dispatch's
+                # episodes (stamp with that dispatch's epoch); host-path
+                # generators return episodes finished under current params
+                stamp_and_feed(gen.step(),
+                               chunk_epoch if getattr(gen, 'pipelined', False)
+                               else dispatch_epoch)
             chunk_epoch = dispatch_epoch
 
             self._run_eval_share(evaluator, eval_tracker)
@@ -1140,7 +1145,13 @@ class Learner:
                                 else put_tree(self.wrapper.params))
                 actor_epoch = self.model_epoch
             epoch_of_dispatch.append(self.model_epoch)
-            warm = self.num_returned_episodes < args['minimum_episodes']
+            # on a mesh, also hold warmup until EVERY shard's ring slice
+            # has at least one window (a shard with local size 0 would feed
+            # all-zero batches into the psum'd gradient); ring_min_host is
+            # one fetch behind, which only extends warmup by one chunk
+            warm = (self.num_returned_episodes < args['minimum_episodes']
+                    or (tr.mesh is not None and fp.dispatches > 0
+                        and fp.ring_min_host < 1))
             t0 = time.time()
             if warm:
                 account(fp.warm_step(actor.params))
@@ -1214,10 +1225,13 @@ class Learner:
         if tr.replay is not None:
             tr.replay_stats['samples_drawn'] += (
                 epoch_steps * self.args['batch_size'])
-            # ring size rides the per-chunk packed fetch — no device sync
+            # ring size + true cumulative ingest count ride the per-chunk
+            # packed fetch — no device sync (ring size saturates at
+            # capacity once the ring wraps; the ingest counter does not)
             tr._ring_size_host = fp.ring_size_host
             tr.replay_stats['windows_ingested'] = max(
-                tr.replay_stats['windows_ingested'], tr._ring_size_host)
+                tr.replay_stats['windows_ingested'],
+                fp.windows_ingested_host)
 
         # Fetching + serializing the full train state dominates short
         # epochs on a tunneled device (~40% of a 100k-episode geese run):

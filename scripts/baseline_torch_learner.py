@@ -64,10 +64,7 @@ def td_lambda_torch(values, returns_last, rewards, lmb, gamma):
     return torch.stack(tv, dim=1)
 
 
-def main():
-    B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    T = 16
+def measure(B, T, steps, bf16=False):
     torch.manual_seed(0)
     rng = np.random.RandomState(0)
 
@@ -81,16 +78,26 @@ def main():
     outcome = torch.from_numpy(np.sign(rng.randn(B, 1, 1)).astype(np.float32))
     rewards = torch.zeros(B, T, 1)
 
-    def one_step():
+    def loss_fn():
         p, v = model(obs.flatten(0, 1))
         p = p.unflatten(0, (B, T))
-        v = v.unflatten(0, (B, T))
-        logp = F.log_softmax(p, -1).gather(-1, actions)
+        v = v.unflatten(0, (B, T)).float()
+        logp = F.log_softmax(p.float(), -1).gather(-1, actions)
         with torch.no_grad():
             rho = torch.clamp((logp.detach() - b_prob.log()).exp(), 0, 1)
             targets = td_lambda_torch(v.detach(), outcome[:, 0], rewards, 0.7, 1.0)
             adv = rho * (targets - v.detach())
-        loss = (-logp * adv).sum() + ((v - targets) ** 2).sum() / 2
+        return (-logp * adv).sum() + ((v - targets) ** 2).sum() / 2
+
+    def one_step():
+        # bf16: autocast the net (convs/matmuls in bfloat16 — the same
+        # activations-only reduction the jax learner's compute_dtype
+        # applies; params/optimizer stay fp32 in both)
+        if bf16:
+            with torch.autocast('cpu', dtype=torch.bfloat16):
+                loss = loss_fn()
+        else:
+            loss = loss_fn()
         opt.zero_grad()
         loss.backward()
         nn.utils.clip_grad_norm_(model.parameters(), 4.0)
@@ -102,15 +109,22 @@ def main():
     for _ in range(steps):
         one_step()
     dt = time.time() - t0
-    traj_per_sec = B * steps / dt
+    return B * steps / dt
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    T = 16
 
     out = {
-        'torch_cpu_trajectories_per_sec': traj_per_sec,
+        'torch_cpu_trajectories_per_sec': measure(B, T, steps, bf16=False),
+        'torch_cpu_bf16_trajectories_per_sec': measure(B, T, steps, bf16=True),
         'batch_size': B, 'forward_steps': T,
         'model': 'GeeseNet(12x32 torus-conv)',
         'device': 'cpu', 'torch_version': torch.__version__,
-        'note': 'reference-style learner step measured on this host; '
-                'see scripts/baseline_torch_learner.py',
+        'note': 'reference-style learner step measured on this host, fp32 '
+                'and bf16-autocast; see scripts/baseline_torch_learner.py',
     }
     path = os.path.join(os.path.dirname(__file__), '..', 'bench_baseline.json')
     with open(os.path.abspath(path), 'w') as f:

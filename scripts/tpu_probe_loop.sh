@@ -1,0 +1,28 @@
+#!/bin/bash
+# Poll the tunneled TPU backend until it comes back. Each probe runs
+# jax.devices() in a subprocess with an INTERNAL deadline (the process
+# exits cleanly on its own; we never SIGKILL a client that might hold
+# the exclusive grant). Logs one line per attempt.
+LOG=${1:-/tmp/tpu_probe.log}
+INTERVAL=${2:-180}
+while true; do
+  TS=$(date +%H:%M:%S)
+  OUT=$(python - <<'PY' 2>&1
+import threading, os, sys
+def bail():
+    os._exit(42)   # clean-ish exit before the driver would signal us
+t = threading.Timer(110, bail); t.daemon = True; t.start()
+import jax
+ds = jax.devices()
+print("OK", ds[0].platform, len(ds))
+os._exit(0)
+PY
+)
+  RC=$?
+  echo "$TS rc=$RC $(echo "$OUT" | tail -c 220 | tr '\n' ' ')" >> "$LOG"
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -q "^OK"; then
+    echo "$TS BACKEND UP" >> "$LOG"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

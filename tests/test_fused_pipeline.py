@@ -51,6 +51,22 @@ def test_tictactoe_fused_pipeline_learner(tmp_path, capsys):
 
 
 @pytest.mark.timeout(600)
+def test_fused_pipeline_ingest_accounting(tmp_path):
+    """windows_ingested must be the CUMULATIVE ingest count, not the ring
+    size (which saturates at capacity once the ring wraps)."""
+    args = apply_defaults(_ttt_raw(
+        tmp_path, maximum_episodes=2, replay_windows_per_episode=2))
+    learner = Learner(args=args)
+    learner.run()
+    capacity = learner.trainer.replay.capacity
+    assert capacity == 4
+    stats = learner.trainer.replay_stats
+    # ~80 episodes x >=1 window each went through a 4-row ring
+    assert stats['windows_ingested'] > capacity * 4
+    assert stats['samples_drawn'] > 0
+
+
+@pytest.mark.timeout(600)
 def test_geese_fused_pipeline_learner(tmp_path, capsys):
     raw = {
         'env_args': {'env': 'HungryGeese'},
