@@ -189,6 +189,38 @@ def test_food_respawn_excludes_occupied_cells():
     assert len(rng_seen) > 1                   # spawn is actually random
 
 
+def test_three_heads_one_cell_kill_all():
+    """A >=3-goose pileup on one cell kills every entrant (the pairwise
+    head-collision rule has no tie-breaking by length here: all die)."""
+    geese = [[5], [27], [15], [60]]           # 5 S, 27 N, 15 E -> cell 16
+    host, dev = _both(geese, [70, 75], {0: S, 1: N, 2: E, 3: E})
+    ha, da = _alive(host, dev)
+    assert ha == da == [False, False, False, True]
+
+
+def test_pileup_on_food_consumes_and_respawns():
+    """Food under a fatal pileup is still eaten (the eat phase precedes the
+    collision phase), so it respawns — cell 16 itself is free again after
+    the deaths and is a legal respawn target, so assert the count + the
+    not-on-occupied-cells invariant, not the respawn location."""
+    geese = [[5], [27], [15], [60]]
+    host, dev = _both(geese, [16, 75], {0: S, 1: N, 2: E, 3: E})
+    ha, da = _alive(host, dev)
+    assert ha == da == [False, False, False, True]
+    occupied = {c for g in host.geese for c in g}
+    assert len(set(host.food)) == 2 and not (set(host.food) & occupied)
+    df = np.asarray(dev.food)[0]
+    assert len(set(df)) == 2 and 61 not in df   # 61 = survivor's new head
+
+
+def test_four_way_pileup_ends_the_episode():
+    geese = [[5], [27], [15], [17]]
+    host, dev = _both(geese, [70, 75], {0: S, 1: N, 2: E, 3: W})
+    ha, da = _alive(host, dev)
+    assert ha == da == [False, False, False, False]
+    assert host.terminal()
+
+
 def test_outcome_ranks_survival_over_length():
     """Survival steps dominate length in the pairwise-rank outcome."""
     host = _host_with([[5], [30, 31, 32], [50], [60]], [70, 75])
@@ -216,8 +248,22 @@ def test_differential_fuzz_host_vs_jax(seed):
         while not host.terminal():
             acts = {p: int(rng.randint(4)) for p in host.turns()}
             dev_acts = [[acts.get(p, 0) for p in range(4)]]
+            pre_food = set(host.food)
+            pre_len = [len(g) for g in host.geese]
+            hunger = (host.step_count + 1) % jhg.HUNGER_RATE == 0
             host.step(dict(acts))
             dev = step_fn(dev, jnp.asarray(dev_acts, jnp.int32))
+            # length-delta law, checked EVERY step: a survivor's length is
+            # pre + ate - hunger_pop (eat keeps the tail, the 40th-step
+            # hunger pops one; simultaneously they cancel). This pins the
+            # hunger boundary and the eat+starve interaction at every
+            # random position the fuzz reaches, not just the fixtures.
+            for p in range(4):
+                if host.alive[p]:
+                    ate = int(host.geese[p][0] in pre_food)
+                    assert len(host.geese[p]) == \
+                        pre_len[p] + ate - int(hunger), \
+                        (episodes, total_steps, p, hunger)
             # food respawn draws from each engine's own PRNG; re-sync the
             # device food to the host's so the transition rules (the thing
             # under test) stay in lockstep
@@ -252,12 +298,63 @@ def test_differential_fuzz_host_vs_jax(seed):
     assert total_steps >= 2600
 
 
+def test_jax_greedy_agreement_on_random_positions():
+    """Same agreement property as the trajectory test below, but over
+    SYNTHETIC random positions (self-avoiding random walks for bodies,
+    random food and last actions) — covering states random play from the
+    start rarely reaches (long bodies, crowded boards)."""
+    from handyrl_tpu.envs.kaggle.hungry_geese import _move
+    from test_jax_geese import greedy_candidates
+
+    rng = np.random.RandomState(11)
+    greedy_fn = jax.jit(jhg.greedy_action)
+    checked = 0
+    for trial in range(500):
+        # lay out 4 disjoint self-avoiding walks on the torus
+        taken: set = set()
+        geese = []
+        for p in range(4):
+            for _attempt in range(20):
+                L = int(rng.randint(1, 7))
+                cell = int(rng.randint(77))
+                body = [cell]
+                while len(body) < L:
+                    nxt = _move(body[-1], int(rng.randint(4)))
+                    if nxt in body or nxt in taken:
+                        break
+                    body.append(nxt)
+                if body[0] not in taken and not (set(body) & taken):
+                    break
+            if set(body) & taken:
+                body = []
+            taken |= set(body)
+            geese.append(body)
+        if not any(geese):
+            continue
+        free = [c for c in range(77) if c not in taken]
+        food = list(rng.choice(free, size=min(2, len(free)), replace=False))
+        last = {p: int(rng.randint(4)) for p in range(4)
+                if geese[p] and rng.rand() < 0.7}
+
+        host = _host_with(geese, food, last_actions=last)
+        dev = _manual_state(geese, food, last_actions=last)
+        dev_acts = np.asarray(greedy_fn(dev, jax.random.PRNGKey(trial)))[0]
+        for p in range(4):
+            if not geese[p]:
+                continue
+            if not greedy_candidates(geese, food, last, p):
+                continue            # both sides fall back randomly
+            host_a = host.rule_based_action(p)
+            checked += 1
+            assert host_a == int(dev_acts[p]), (trial, p, geese, food, last)
+    assert checked >= 800
+
+
 def test_jax_greedy_agrees_with_host_rulebase():
     """The vectorized device GreedyAgent must choose the SAME action as the
     host behavioral port on every state where the host pick is not the
     random fallback (fallbacks draw from different PRNGs)."""
-    from handyrl_tpu.envs.kaggle.hungry_geese import (
-        GREEDY_ACTION_ORDER, OPPOSITE as HOST_OPP, _move)
+    from test_jax_geese import greedy_candidates
 
     rng = np.random.RandomState(7)
     step_fn = jax.jit(jhg.step)
@@ -272,28 +369,14 @@ def test_jax_greedy_agrees_with_host_rulebase():
             for p in host.turns():
                 # detect the host fallback (no legal candidate) by
                 # re-deriving the candidate set per the documented rules
-                goose = host.geese[p]
-                opp = [g for q, g in enumerate(host.geese)
-                       if q != p and g]
-                head_adj = {_move(g[0], a) for g in opp for a in range(4)}
-                bodies = {c for g in host.geese for c in g[:-1]}
-                eat_tails = {g[-1] for g in opp
-                             if any(_move(g[0], a) in host.food
-                                    for a in range(4))}
-                last = host.last_actions.get(p)
-                banned = HOST_OPP[last] if last is not None else None
-                cands = [a for a in GREEDY_ACTION_ORDER
-                         if a != banned
-                         and _move(goose[0], a) not in head_adj
-                         and _move(goose[0], a) not in bodies
-                         and _move(goose[0], a) not in eat_tails]
-                if not cands:
+                if not greedy_candidates(host.geese, host.food,
+                                         host.last_actions, p):
                     continue            # both sides fall back randomly
                 host_a = host.rule_based_action(p)
                 checked += 1
                 agreed += int(host_a == int(dev_acts[p]))
                 assert host_a == int(dev_acts[p]), (ep, p, host.geese,
-                                                    host.food, cands)
+                                                    host.food)
             acts = {p: int(rng.randint(4)) for p in host.turns()}
             host.step(dict(acts))
             dev = step_fn(dev, jnp.asarray([[acts.get(p, 0)

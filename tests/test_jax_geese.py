@@ -55,6 +55,29 @@ def _host_with(geese, food, last_actions=None, steps=0):
     return e
 
 
+def greedy_candidates(geese, food, last_actions, p):
+    """Re-derive the host GreedyAgent's legal-candidate set for seat ``p``
+    (docs/geese_rules.md): not the banned reversal, not adjacent to an
+    opponent head, not a body cell, not a tail an opponent could keep by
+    eating. Shared by the conformance agreement tests so the rule encoding
+    cannot drift between them."""
+    from handyrl_tpu.envs.kaggle.hungry_geese import (
+        GREEDY_ACTION_ORDER, OPPOSITE as HOST_OPP, _move)
+    goose = geese[p]
+    opp = [g for q, g in enumerate(geese) if q != p and g]
+    head_adj = {_move(g[0], a) for g in opp for a in range(4)}
+    bodies = {c for g in geese if g for c in g[:-1]}
+    eat_tails = {g[-1] for g in opp
+                 if any(_move(g[0], a) in food for a in range(4))}
+    last = last_actions.get(p)
+    banned = HOST_OPP[last] if last is not None else None
+    return [a for a in GREEDY_ACTION_ORDER
+            if a != banned
+            and _move(goose[0], a) not in head_adj
+            and _move(goose[0], a) not in bodies
+            and _move(goose[0], a) not in eat_tails]
+
+
 SCENARIOS = [
     # (geese, food, actions, name)
     ([[0], [20], [40], [60]], [5, 70], {0: 3, 1: 3, 2: 3, 3: 3}, 'all-east'),
