@@ -397,9 +397,10 @@ class DeviceEvaluator:
     inferenced inside the same compiled ply — and the host receives only
     (done, outcome, seat) per ply, K plies of N matches per dispatch.
     'rulebase' also runs on device when the env twin vectorizes its agent
-    (``greedy_action``, e.g. jax_hungry_geese); otherwise it and model
-    opponents for recurrent nets stay on the host evaluator
-    (train.py device_eval_ok).
+    (``greedy_action``, e.g. jax_hungry_geese); otherwise it stays on the
+    host evaluator (train.py device_eval_ok). Checkpoint opponents for
+    recurrent nets carry their own hidden tree through the scan, so e.g.
+    Geister league eval keeps the one-dispatch-per-chunk budget.
     """
 
     def __init__(self, env_mod, wrapper, args: Dict[str, Any],
@@ -417,8 +418,7 @@ class DeviceEvaluator:
         # device evaluator silently fell back to the per-ply host evaluator
         # for anything but 'random'). 'random' plays uniform; a checkpoint
         # path plays its own greedy policy, inferenced inside the same
-        # compiled ply. Recurrent opponents are refused at construction
-        # (the Learner falls back to the host evaluator for those).
+        # compiled ply (recurrent checkpoints carry opp_hidden, below).
         self.opponents = [str(o) for o in (opponents or ['random'])]
         assert n_envs >= len(self.opponents), \
             'need at least one eval env per opponent'
@@ -436,8 +436,6 @@ class DeviceEvaluator:
         model_opps = [o for o in self.opponents
                       if o not in ('random', 'rulebase')]
         if model_opps:
-            assert not self.recurrent, \
-                'device eval with model opponents needs a feedforward net'
             # the trained wrapper's params are the ready-made template for
             # msgpack deserialization (same module, same tree)
             from flax import serialization
@@ -445,6 +443,12 @@ class DeviceEvaluator:
                 with open(path, 'rb') as f:
                     self._opp_params.append(jax.device_put(
                         serialization.from_bytes(wrapper.params, f.read())))
+        # recurrent checkpoint opponents carry their own hidden tree through
+        # the scan (gathered/scattered exactly like the main model's); the
+        # env blocks are disjoint so ONE tree serves every opponent slice
+        self.opp_hidden = (wrapper.module.init_hidden(
+            (n_envs, env_mod.NUM_PLAYERS))
+            if self.recurrent and model_opps else None)
         if mesh is not None:
             # eval envs sharded over 'data' alongside the fused trainer
             # (params arrive replicated); the plain-jit rollout partitions
@@ -453,6 +457,8 @@ class DeviceEvaluator:
             self.state = shard_batch(mesh, self.state)
             if self.hidden is not None:
                 self.hidden = shard_batch(mesh, self.hidden)
+            if self.opp_hidden is not None:
+                self.opp_hidden = shard_batch(mesh, self.opp_hidden)
             self.seat = shard_batch(mesh, self.seat)
             self.rng = jax.device_put(self.rng, replicated_sharding(mesh))
         self._pending = None
@@ -469,9 +475,10 @@ class DeviceEvaluator:
         any_rulebase = any(name == 'rulebase' for _, _, name in opp_bounds)
 
         @jax.jit
-        def rollout(params, opp_params, state, hidden, seat, rng):
+        def rollout(params, opp_params, state, hidden, opp_hidden, seat,
+                    rng):
             def body(carry, _):
-                state, hidden, seat, rng = carry
+                state, hidden, opp_hidden, seat, rng = carry
                 obs, logits, amask, hidden, _ = _ply_inference(
                     env_mod, apply_fn, recurrent, simultaneous,
                     params, state, hidden)
@@ -483,7 +490,9 @@ class DeviceEvaluator:
                     rule_act = env_mod.greedy_action(state, rkey)
                 # opponent blocks: checkpoint policies (greedy) and the
                 # rulebase agent, traced into this one program (static
-                # slices)
+                # slices). Recurrent checkpoints gather/scatter their own
+                # hidden tree the same way _ply_inference does the main
+                # model's — the blocks are disjoint slices of opp_hidden.
                 for a, b, name in opp_bounds:
                     if name == 'random' or a == b:
                         continue
@@ -491,15 +500,42 @@ class DeviceEvaluator:
                         opp_act = opp_act.at[a:b].set(rule_act[a:b])
                         continue
                     pg = opp_params[model_ix[name]]
-                    o = obs[a:b]
+                    # observations may be a pytree (e.g. geister's
+                    # {'scalar', 'board'}): slice every leaf
+                    o = jax.tree_util.tree_map(lambda x: x[a:b], obs)
                     if simultaneous:
-                        No, Po = o.shape[:2]
-                        out_o = apply_fn(pg, o.reshape((No * Po,)
-                                                       + o.shape[2:]), None)
+                        No, Po = jax.tree_util.tree_leaves(o)[0].shape[:2]
+                        flat = jax.tree_util.tree_map(
+                            lambda x: x.reshape((No * Po,) + x.shape[2:]),
+                            o)
+                        if recurrent:
+                            h_in = jax.tree_util.tree_map(
+                                lambda h: h[a:b].reshape((No * Po,)
+                                                         + h.shape[2:]),
+                                opp_hidden)
+                            out_o = dict(apply_fn(pg, flat, h_in))
+                            nh = out_o.pop('hidden')
+                            opp_hidden = jax.tree_util.tree_map(
+                                lambda h, x: h.at[a:b].set(
+                                    x.reshape((No, Po) + x.shape[1:])),
+                                opp_hidden, nh)
+                        else:
+                            out_o = dict(apply_fn(pg, flat, None))
                         lg = (out_o['policy'].reshape(No, Po, -1)
                               - amask[a:b])
                     else:
-                        out_o = apply_fn(pg, o, None)
+                        if recurrent:
+                            rows = jnp.arange(b - a)
+                            pl = env_mod.turn(state)[a:b]
+                            h_in = jax.tree_util.tree_map(
+                                lambda h: h[a:b][rows, pl], opp_hidden)
+                            out_o = dict(apply_fn(pg, o, h_in))
+                            nh = out_o.pop('hidden')
+                            opp_hidden = jax.tree_util.tree_map(
+                                lambda h, x: h.at[a + rows, pl].set(x),
+                                opp_hidden, nh)
+                        else:
+                            out_o = dict(apply_fn(pg, o, None))
                         lg = out_o['policy'] - amask[a:b]
                     opp_act = opp_act.at[a:b].set(jnp.argmax(lg, axis=-1))
                 if simultaneous:
@@ -517,11 +553,15 @@ class DeviceEvaluator:
                                  (seat + 1) % env_mod.NUM_PLAYERS, seat)
                 if recurrent:
                     hidden = _reset_hidden_where_done(hidden, done)
-                return (nstate, hidden, seat, rng), record
+                    if opp_hidden is not None:
+                        opp_hidden = _reset_hidden_where_done(
+                            opp_hidden, done)
+                return (nstate, hidden, opp_hidden, seat, rng), record
 
-            (state, hidden, seat, rng), records = jax.lax.scan(
-                body, (state, hidden, seat, rng), None, length=chunk_steps)
-            return state, hidden, seat, rng, records
+            (state, hidden, opp_hidden, seat, rng), records = jax.lax.scan(
+                body, (state, hidden, opp_hidden, seat, rng), None,
+                length=chunk_steps)
+            return state, hidden, opp_hidden, seat, rng, records
 
         self._rollout = rollout
 
@@ -531,9 +571,10 @@ class DeviceEvaluator:
 
     def _dispatch(self):
         """Dispatch a chunk + its packed (done, seat, outcome) fetchable."""
-        self.state, self.hidden, self.seat, self.rng, records = \
+        (self.state, self.hidden, self.opp_hidden, self.seat, self.rng,
+         records) = \
             self._rollout(self.wrapper.params, tuple(self._opp_params),
-                          self.state, self.hidden,
+                          self.state, self.hidden, self.opp_hidden,
                           self.seat, self.rng)
         self.dispatches += 1
         records = dict(records)

@@ -916,10 +916,11 @@ class Learner:
         opponents = args.get('eval', {}).get('opponent', []) or ['random']
 
         def device_eval_ok():
-            """'random', checkpoint, and (where the env twin vectorizes
-            its agent as ``greedy_action``) 'rulebase' opponents run on
-            device; other rulebases and model opponents for recurrent nets
-            (their hidden carry is not plumbed) use the host evaluator."""
+            """'random', checkpoint (feedforward OR recurrent — the
+            evaluator plumbs an opponent hidden tree through the rollout
+            scan), and (where the env twin vectorizes its agent as
+            ``greedy_action``) 'rulebase' opponents run on device; other
+            rulebases use the host evaluator."""
             if env_mod is None or not args.get('device_eval', True):
                 return False
             if len(opponents) > eval_envs:   # every opponent needs an env
@@ -929,9 +930,8 @@ class Learner:
                     continue
                 if o == 'rulebase' and hasattr(env_mod, 'greedy_action'):
                     continue   # vectorized rulebase runs on device
-                if (isinstance(o, str) and os.path.exists(o)
-                        and not hasattr(actor.module, 'init_hidden')):
-                    continue
+                if isinstance(o, str) and os.path.exists(o):
+                    continue   # checkpoint league opponent
                 return False
             return True
 

@@ -126,6 +126,116 @@ def test_model_opponent_differs_from_random():
             assert len(outs) == 1, (seat, outs)
 
 
+def test_recurrent_checkpoint_opponent_on_device(tmp_path):
+    """Geister league eval on device: a RECURRENT (DRC) checkpoint opponent
+    plays inside the compiled chunk — its hidden state carried through the
+    rollout scan — instead of falling back to the per-ply host evaluator."""
+    from handyrl_tpu.envs import jax_geister
+    from handyrl_tpu.models.geister import GeisterNet
+
+    obs = jax_geister.observe(jax_geister.init_state(1))
+    module = GeisterNet(filters=8, drc_layers=1)
+    w = _wrapper(module, obs)
+    w2 = ModelWrapper(GeisterNet(filters=8, drc_layers=1))
+    w2.params = w2.module.init(jax.random.PRNGKey(9), obs, None)
+    path = str(tmp_path / 'opp.ckpt')
+    with open(path, 'wb') as f:
+        f.write(w2.params_bytes())
+
+    ev = DeviceEvaluator(jax_geister, w, {}, n_envs=4, chunk_steps=32,
+                         opponents=[path])
+    assert ev.recurrent and ev.opp_hidden is not None
+    results = []
+    for _ in range(16):
+        results.extend(ev.step())
+        if len(results) >= 4:
+            break
+    assert len(results) >= 4
+    for r in results:
+        assert r['opponent'] == path
+        outcome = r['result']
+        assert outcome[0] + outcome[1] == 0        # zero-sum
+        (seat,) = r['args']['player']
+        assert r['args']['model_id'][seat] == 0
+    # the opponent's hidden tree is live device state, not zeros: at least
+    # one leaf must have been written by the checkpoint policy's DRC
+    leaves = jax.tree_util.tree_leaves(ev.opp_hidden)
+    assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
+
+
+def test_recurrent_checkpoint_opponent_simultaneous_env(tmp_path):
+    """Same league-eval plumbing on a SIMULTANEOUS env: a recurrent
+    (LSTM) geese checkpoint opponent folds its (N, P) hidden through the
+    batch dim inside the compiled chunk."""
+    from handyrl_tpu.models import build
+
+    obs = np.zeros((1, 17, 7, 11), np.float32)
+    module = build('GeeseNetLSTM', filters=8, stem_layers=1)
+    w = _wrapper(module, obs)
+    w2 = ModelWrapper(build('GeeseNetLSTM', filters=8, stem_layers=1))
+    w2.params = w2.module.init(jax.random.PRNGKey(5), obs, None)
+    path = str(tmp_path / 'opp_lstm.ckpt')
+    with open(path, 'wb') as f:
+        f.write(w2.params_bytes())
+
+    ev = DeviceEvaluator(jax_hungry_geese, w, {}, n_envs=4, chunk_steps=24,
+                         opponents=[path])
+    assert ev.recurrent and ev.opp_hidden is not None
+    results = []
+    for _ in range(20):
+        results.extend(ev.step())
+        if len(results) >= 4:
+            break
+    assert len(results) >= 4
+    for r in results:
+        assert r['opponent'] == path
+        assert set(r['result']) == {0, 1, 2, 3}
+        assert all(-1.0 <= v <= 1.0 for v in r['result'].values())
+    leaves = jax.tree_util.tree_leaves(ev.opp_hidden)
+    assert any(float(np.abs(np.asarray(l)).max()) > 0 for l in leaves)
+
+
+def test_learner_selects_device_eval_for_recurrent_league(tmp_path,
+                                                          monkeypatch):
+    """The Learner's device_eval_ok gate must keep a RECURRENT net with a
+    checkpoint league opponent on the device evaluator (the host fallback
+    is the dispatch-per-ply path the device evaluator exists to kill)."""
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.models.geister import GeisterNet
+    from handyrl_tpu import train as train_mod
+    from handyrl_tpu.train import Learner
+
+    net = GeisterNet(filters=8, drc_layers=1)
+    w = ModelWrapper(GeisterNet(filters=8, drc_layers=1))
+    from handyrl_tpu.envs import jax_geister
+    w.params = w.module.init(jax.random.PRNGKey(3),
+                             jax_geister.observe(jax_geister.init_state(1)),
+                             None)
+    ckpt = tmp_path / 'league_opp.ckpt'
+    ckpt.write_bytes(w.params_bytes())
+
+    def _boom(*a, **k):
+        raise AssertionError('host evaluator constructed: device_eval_ok '
+                             'rejected the recurrent league opponent')
+    monkeypatch.setattr(train_mod, 'BatchedEvaluator', _boom)
+
+    raw = {
+        'env_args': {'env': 'Geister'},
+        'train_args': {
+            'turn_based_training': True, 'observation': True,
+            'gamma': 0.9, 'forward_steps': 4, 'compress_steps': 2,
+            'batch_size': 4, 'update_episodes': 6, 'minimum_episodes': 6,
+            'epochs': 1, 'generation_envs': 4, 'num_batchers': 1,
+            'device_generation': True, 'device_replay': True,
+            'eval': {'opponent': [str(ckpt)]},
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    learner = Learner(args=apply_defaults(raw), net=net)
+    learner.run()
+    assert learner.model_epoch == 1
+
+
 def test_geese_rulebase_opponent_on_device():
     """The vectorized GreedyAgent plays the opponent seats on device; the
     untrained net should score clearly WORSE vs rulebase than vs random."""
