@@ -1,0 +1,189 @@
+"""Distributed worker-fleet scaling: episodes/sec vs worker count.
+
+The reference's headline deployment is many worker hosts feeding one
+learner over TCP (reference worker.py:169-254, docs/large_scale_training
+.md). This measures the same axis here: a --train-server Learner and one
+worker host process with ``num_parallel`` = N (the reference's per-host
+fleet knob), N swept over 1/2/4/8/16, steady-state episodes/sec sampled
+at the learner AFTER a warmup interval so compile + handshake don't
+pollute the number.
+
+One-host caveat (recorded with every row): learner SGD, the Hub, and all
+N worker processes share this box's single CPU core, so the curve shows
+where the shared-core ceiling lands, not the DCN protocol's limit; on a
+real deployment the workers' generation compute is elsewhere and only
+the (measured-cheap) framed-msgpack ingest path remains at the learner.
+
+Run: JAX_PLATFORMS=cpu python scripts/worker_scaling_bench.py
+     [--workers 1,2,4,8,16] [--window 55] [--warmup 20]
+Appends one JSON row per N to benchmarks.jsonl.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LEARNER_SCRIPT = r'''
+import json, os, sys, threading, time
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+
+def main():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.train import Learner
+
+    warmup = float(sys.argv[1])
+    window = float(sys.argv[2])
+    out_path = sys.argv[3]
+
+    raw = {'env_args': {'env': 'TicTacToe'},
+           'train_args': {'batch_size': 64, 'update_episodes': 10**9,
+                          'minimum_episodes': 10**9,  # never train:
+                          'epochs': 1,                # isolate ingest
+                          'forward_steps': 8, 'num_batchers': 1,
+                          'model_dir': os.path.join(
+                              os.path.dirname(out_path), 'models')}}
+    args = apply_defaults(raw)
+    learner = Learner(args=args, remote=True)
+
+    def monitor():
+        # readiness gate: the fleet is "up" once episodes actually flow
+        # (spawned workers re-import jax serially on a shared core, so a
+        # fixed sleep can open the window mid-ramp); ``warmup`` is then a
+        # settling pad after first arrival, capped by ready_deadline
+        t0 = time.time()
+        ready_deadline = t0 + 600
+        while (learner.num_returned_episodes == 0
+               and time.time() < ready_deadline):
+            time.sleep(0.5)
+        time.sleep(warmup)
+        n0, s0 = learner.num_returned_episodes, time.time()
+        time.sleep(window)
+        n1, s1 = learner.num_returned_episodes, time.time()
+        with open(out_path, 'w') as f:
+            json.dump({'episodes': n1 - n0, 'seconds': s1 - s0,
+                       'eps_per_sec': (n1 - n0) / (s1 - s0)}, f)
+        # unblock the server accept loop promptly
+        os._exit(0)
+
+    threading.Thread(target=monitor, daemon=True).start()
+    learner.run()
+
+
+if __name__ == '__main__':   # spawn-context safe (WorkerCluster)
+    main()
+'''
+
+WORKER_SCRIPT = r'''
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+
+def main():
+    from handyrl_tpu.worker import worker_main
+    args = {'worker_args': {'server_address': 'localhost',
+                            'num_parallel': int(sys.argv[1])}}
+    worker_main(args, [])
+
+
+if __name__ == '__main__':   # spawn-context safe (WorkerCluster)
+    main()
+'''
+
+
+def measure(n_workers: int, warmup: float, window: float,
+            hosts_mode: bool = False):
+    """hosts_mode=False: ONE worker host, num_parallel=N (for N<=16 its
+    default_num_gathers gives a single learner-side data connection).
+    hosts_mode=True: N worker host processes, num_parallel=1 each — N
+    entry handshakes, N Gather connections, N Hub endpoints at the
+    learner, i.e. the actual multi-host fan-in path."""
+    env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
+           'PYTHONPATH': REPO + os.pathsep + os.environ.get('PYTHONPATH', '')}
+    with tempfile.TemporaryDirectory() as td:
+        learner_py = os.path.join(td, 'learner.py')
+        worker_py = os.path.join(td, 'worker.py')
+        out_path = os.path.join(td, 'result.json')
+        with open(learner_py, 'w') as f:
+            f.write(LEARNER_SCRIPT)
+        with open(worker_py, 'w') as f:
+            f.write(WORKER_SCRIPT)
+        learner = subprocess.Popen(
+            [sys.executable, learner_py, str(warmup), str(window), out_path],
+            env=env, cwd=td, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        time.sleep(3.0)          # entry server up before workers knock
+        fleet = [(1, 1)] * n_workers if hosts_mode else [(n_workers, 1)]
+        workers = [subprocess.Popen(
+            [sys.executable, worker_py, str(np_)],
+            env=env, cwd=td, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL) for np_, _ in fleet]
+        try:
+            learner.wait(timeout=warmup + window + 660)
+        finally:
+            for proc in workers + [learner]:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)   # CPU-only: no grant
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+        if not os.path.exists(out_path):
+            return None
+        with open(out_path) as f:
+            return json.load(f)
+
+
+def main():
+    workers = [1, 2, 4, 8, 16]
+    warmup, window = 20.0, 55.0
+    hosts_mode = False
+    argv = iter(sys.argv[1:])
+    for a in argv:
+        key, _, val = a.partition('=')
+        if key in ('--workers', '--window', '--warmup') and not val:
+            try:
+                val = next(argv)
+            except StopIteration:
+                raise SystemExit('%s needs a value' % key)
+        if key == '--workers':
+            workers = [int(x) for x in val.split(',')]
+        elif key == '--window':
+            window = float(val)
+        elif key == '--warmup':
+            warmup = float(val)
+        elif key == '--hosts':
+            hosts_mode = True
+        else:
+            raise SystemExit('unknown argument %r' % a)
+    out = os.path.join(REPO, 'benchmarks.jsonl')
+    for n in workers:
+        res = measure(n, warmup, window, hosts_mode)
+        row = {'row': ('worker-scaling-hosts' if hosts_mode
+                       else 'worker-scaling'),
+               'workers': n,
+               'episodes_per_sec': (round(res['eps_per_sec'], 2)
+                                    if res else None),
+               'window_s': window,
+               'note': ('N worker-host procs, 1 worker each: N Gather '
+                        'connections into the learner Hub'
+                        if hosts_mode else
+                        'one worker host, num_parallel=N: single Gather '
+                        'connection') +
+                       '; one shared CPU core; learner SGD disabled',
+               'time': time.strftime('%Y-%m-%d %H:%M:%S')}
+        print(json.dumps(row), flush=True)
+        with open(out, 'a') as f:
+            f.write(json.dumps(row) + '\n')
+
+
+if __name__ == '__main__':
+    main()
