@@ -44,7 +44,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'device_ingest': True,        # assemble training windows on device (device_generation + device_replay, single-device)
     'device_generation': False,   # fully device-resident rollouts (envs with a pure-JAX twin)
     'device_replay': False,       # HBM-resident replay ring; batches sampled on device
-    'replay_windows_per_episode': None,  # ring capacity budget per episode; None = max(1, 64 // forward_steps)
+    'replay_windows_per_episode': None,  # windows ingested per episode (uniformly placed); sets both the ring budget and the sampling WEIGHTING — 1 = exact per-episode mass like the reference's draw (train.py:291-306), >1 weights long episodes by min(len//fs, W). None = max(1, 64 // forward_steps)
     'replay_fused_steps': 8,      # SGD steps fused into one device program in device_replay mode
     'max_sample_reuse': None,     # device_replay threaded trainer: cap samples-drawn / windows-ingested (None = free-spin like the reference)
     'fused_pipeline': True,       # one dispatch = rollout chunk + ingest + K SGD steps (device_ingest configs)

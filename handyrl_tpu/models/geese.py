@@ -24,9 +24,10 @@ class GeeseNetLSTM(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
+        # distinct arrays per leaf: donating consumers hand the tree to
+        # XLA, which refuses to donate one buffer twice
         shape = tuple(batch_shape) + (7, 11, self.filters)
-        zeros = jnp.zeros(shape, self.dtype)
-        return (zeros, zeros)
+        return (jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
 
     @nn.compact
     def __call__(self, obs, hidden):

@@ -23,10 +23,13 @@ class GeisterNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
-        """Zero DRC state: (hs, cs) lists of (..., 6, 6, F) arrays."""
+        """Zero DRC state: (hs, cs) lists of (..., 6, 6, F) arrays —
+        DISTINCT arrays per leaf (donating consumers may pass the tree to
+        XLA, which refuses to donate one buffer twice)."""
         shape = tuple(batch_shape) + (6, 6, self.filters)
-        zeros = jnp.zeros(shape, self.dtype)
-        return ([zeros] * self.drc_layers, [zeros] * self.drc_layers)
+        mk = lambda: jnp.zeros(shape, self.dtype)  # noqa: E731
+        return ([mk() for _ in range(self.drc_layers)],
+                [mk() for _ in range(self.drc_layers)])
 
     @nn.compact
     def __call__(self, obs, hidden):

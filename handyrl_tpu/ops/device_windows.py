@@ -42,6 +42,33 @@ def _take(hist_leaf, idxm):
     return hist_leaf[idxm]
 
 
+def flatten_window_keys(win: Dict[str, Any]) -> Dict[str, Any]:
+    """Window dicts may carry a PYTREE observation (e.g. geister's
+    {'scalar', 'board'}); the ring stores flat 2-D rows per leaf, so
+    nested leaves become dotted keys ('observation.board')."""
+    out = {}
+    for k, v in win.items():
+        if isinstance(v, dict):
+            for sk, sv in v.items():
+                out['%s.%s' % (k, sk)] = sv
+        else:
+            out[k] = v
+    return out
+
+
+def unflatten_window_keys(win: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of flatten_window_keys — rebuilds the batch pytree the
+    loss consumes (batch['observation'] nested again)."""
+    out: Dict[str, Any] = {}
+    for k, v in win.items():
+        if '.' in k:
+            base, sub = k.split('.', 1)
+            out.setdefault(base, {})[sub] = v
+        else:
+            out[k] = v
+    return out
+
+
 def build_windows_solo(hist: Dict[str, Any], S, ts, seat, outcome,
                        fs: int, bi: int, L: int):
     """Windows for ONE env in solo layout.
@@ -64,8 +91,9 @@ def build_windows_solo(hist: Dict[str, Any], S, ts, seat, outcome,
             c = cond.reshape((-1,) + (1,) * (x.ndim - 1))
             return jnp.where(c, x, fill)
 
-        obs = _take(hist['obs'], idxm)[:, seat_w][:, None]          # (T,1,...)
-        obs = vmask(obs, 0.0, valid)
+        obs = jax.tree_util.tree_map(          # obs may be a pytree
+            lambda x: vmask(_take(x, idxm)[:, seat_w][:, None], 0.0, valid),
+            hist['obs'])                                            # (T,1,...)
         prob = jnp.where(valid, _take(hist['prob'], idxm)[:, seat_w], 1.0)
         act = jnp.where(valid, _take(hist['action'], idxm)[:, seat_w], 0)
         amask = vmask(_take(hist['amask'], idxm)[:, seat_w][:, None],
@@ -96,7 +124,7 @@ def build_windows_solo(hist: Dict[str, Any], S, ts, seat, outcome,
             'progress': progress.astype(f32)[:, None],
         }
 
-    return jax.vmap(one)(ts, seat)
+    return jax.vmap(lambda t, s: flatten_window_keys(one(t, s)))(ts, seat)
 
 
 def build_windows_turn(hist: Dict[str, Any], S, ts, outcome,
@@ -121,7 +149,9 @@ def build_windows_turn(hist: Dict[str, Any], S, ts, outcome,
             c = cond.reshape((-1,) + (1,) * (x.ndim - 1))
             return jnp.where(c, x, fill)
 
-        obs = vmask(_take(hist['obs'], idxm)[:, None], 0.0, in_ep)
+        obs = jax.tree_util.tree_map(          # obs may be a pytree
+            lambda x: vmask(_take(x, idxm)[:, None], 0.0, in_ep),
+            hist['obs'])
         prob = jnp.where(in_ep, _take(hist['prob'], idxm), 1.0)
         act = jnp.where(in_ep, _take(hist['action'], idxm), 0)
         amask = vmask(_take(hist['amask'], idxm)[:, None], 1e32, in_ep)
@@ -156,7 +186,7 @@ def build_windows_turn(hist: Dict[str, Any], S, ts, outcome,
             'progress': progress.astype(f32)[:, None],
         }
 
-    return jax.vmap(one)(ts)
+    return jax.vmap(lambda t: flatten_window_keys(one(t)))(ts)
 
 
 def _discounted_returns(rewards, valid, gamma: float):
@@ -205,10 +235,12 @@ class DeviceWindower:
         """Zero history buffers shaped after one rollout chunk's records."""
         hist = {}
         for key in self._hist_keys():
-            leaf = records[key]
-            # records leaf (K, N, ...) -> hist (N, L, ...)
-            N = leaf.shape[1]
-            hist[key] = jnp.zeros((N, self.L) + leaf.shape[2:], leaf.dtype)
+            # records leaf (K, N, ...) -> hist (N, L, ...); 'obs' may be a
+            # pytree (dict observations), so map over leaves
+            hist[key] = jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros(
+                    (leaf.shape[1], self.L) + leaf.shape[2:], leaf.dtype),
+                records[key])
         return {'hist': hist,
                 'counts': jnp.zeros((records['done'].shape[1],), jnp.int32)}
 
@@ -232,9 +264,10 @@ class DeviceWindower:
         31 GB allocation. 2-D storage pads ~1%; consumers reshape after
         gather via ``window_spec``."""
         def spec_of(key):
-            leaf = records[key]
-            return jax.ShapeDtypeStruct((self.L,) + tuple(leaf.shape[2:]),
-                                        leaf.dtype)
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    (self.L,) + tuple(leaf.shape[2:]), leaf.dtype),
+                records[key])
 
         hist1 = {k: spec_of(k) for k in self._hist_keys()}
         if self.has_reward:
@@ -260,9 +293,11 @@ class DeviceWindower:
                 for k, (shape, dtype) in self.window_spec.items()}
 
     def unflatten_rows(self, rows: Dict[str, Any]) -> Dict[str, Any]:
-        """(n, flat) ring rows -> (n,) + window shape, per leaf."""
-        return {k: v.reshape((v.shape[0],) + self.window_spec[k][0])
-                for k, v in rows.items()}
+        """(n, flat) ring rows -> batch pytree: (n,) + window shape per
+        leaf, dotted keys rebuilt into the nested observation."""
+        return unflatten_window_keys(
+            {k: v.reshape((v.shape[0],) + self.window_spec[k][0])
+             for k, v in rows.items()})
 
     # -- the ingest program ------------------------------------------------
     def ingest(self, records, state, ring, cursor, size, rng):
@@ -293,7 +328,9 @@ class DeviceWindower:
             idx = jnp.clip(counts, 0, L - 1)
 
             for key in hist_record_keys:
-                hist[key] = hist[key].at[rows, idx].set(rec[key])
+                hist[key] = jax.tree_util.tree_map(
+                    lambda h, r: h.at[rows, idx].set(r),
+                    hist[key], rec[key])
             counts = counts + 1
             done = rec['done']                       # (N,) bool
             S = counts                               # (N,) episode lengths

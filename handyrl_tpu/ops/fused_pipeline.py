@@ -67,6 +67,12 @@ class FusedPipeline:
         n_loc = n_envs // ndev            # per-shard envs
         b_loc = batch_size // ndev        # per-shard SGD batch slice
         _init_rollout_engine(self, env_mod, wrapper, n_envs, seed)
+        if self.hidden is not None:
+            # models may alias hidden leaves (e.g. GeisterNet's
+            # ``[zeros] * layers``); every dispatch donates the tree, and
+            # XLA refuses to donate one buffer twice — copy into distinct
+            # buffers once here
+            self.hidden = jax.tree_util.tree_map(jnp.copy, self.hidden)
         rollout_chunk = make_gen_body(env_mod, wrapper.module.apply,
                                       self.recurrent, self.simultaneous)
         ingest = windower.ingest_fn()
@@ -110,8 +116,10 @@ class FusedPipeline:
         probe_update = _update_core(wrapper.module, cfg, make_optimizer())
 
         def _probe(params):
-            batch = {k: jnp.zeros((batch_size,) + shape, dtype)
-                     for k, (shape, dtype) in windower.window_spec.items()}
+            from .device_windows import unflatten_window_keys
+            batch = unflatten_window_keys(
+                {k: jnp.zeros((batch_size,) + shape, dtype)
+                 for k, (shape, dtype) in windower.window_spec.items()})
             ts = init_train_state(params)
             _, metrics = probe_update(ts, batch, jnp.float32(0.0))
             return metrics
@@ -149,9 +157,12 @@ class FusedPipeline:
                                       batch_rows)
                 # ring rows are stored flat (device_windows.init_ring);
                 # restore the (B, T, P, ...) window shape after the gather
-                batch = {k: ring[k][slots].reshape(
-                            (batch_rows,) + windower.window_spec[k][0])
-                         for k in ring}
+                # and rebuild the batch pytree (dotted keys -> nested obs)
+                from .device_windows import unflatten_window_keys
+                batch = unflatten_window_keys(
+                    {k: ring[k][slots].reshape(
+                        (batch_rows,) + windower.window_spec[k][0])
+                     for k in ring})
                 lr = (default_lr * data_cnt_ema
                       / (1 + ts.steps.astype(jnp.float32) * 1e-5))
                 ts, metrics = update(ts, batch, lr)

@@ -149,8 +149,10 @@ def build_replay_update(module, cfg: LossConfig, capacity: int,
             return jax.tree_util.tree_map(lambda b: b[slots], buffers)
         spec, treedef = spec_fn()
         if isinstance(buffers, dict):
-            return {k: buffers[k][slots].reshape(
-                        (batch_size,) + spec[k][0]) for k in buffers}
+            from .device_windows import unflatten_window_keys
+            return unflatten_window_keys(
+                {k: buffers[k][slots].reshape(
+                    (batch_size,) + spec[k][0]) for k in buffers})
         rows = [b[slots].reshape((batch_size,) + shape)
                 for b, (shape, _) in zip(buffers, spec)]
         return jax.tree_util.tree_unflatten(treedef, rows)

@@ -150,6 +150,7 @@ class Trainer:
         self.wrapper = wrapper
         self.episodes: deque = deque()
         self.cfg = LossConfig.from_args(args)
+        self.device_cfg = self.cfg   # may be relayered by the ingest gate
 
         n_dev = len(jax.devices())
         self.mesh = None
@@ -182,7 +183,6 @@ class Trainer:
         self.ingest_queue: Optional[queue.Queue] = None
         if args.get('device_replay'):
             from .ops.replay import DeviceReplay
-            from .ops.train_step import build_replay_update
             # ring capacity budget per episode: how many training windows a
             # typical episode contributes; override via config
             # 'replay_windows_per_episode' (default assumes ~64-step episodes)
@@ -202,17 +202,7 @@ class Trainer:
             # update all stay on device inside one lax.scan, so replay-mode
             # throughput is bounded by compute, not dispatch latency
             self.fused_steps = max(1, int(args.get('replay_fused_steps') or 8))
-            self.replay_update = build_replay_update(
-                wrapper.module, self.cfg, capacity=self.replay.capacity,
-                batch_size=args['batch_size'], num_steps=self.fused_steps,
-                default_lr=self.default_lr, mesh=self.mesh,
-                # window shapes resolved at trace time (first update): by
-                # then either the windower ring (device ingest) or the
-                # DeviceReplay (host push) has seen its first windows
-                spec_fn=lambda: (
-                    (self.windower.window_spec, None)
-                    if getattr(self, 'windower', None) is not None
-                    else (self.replay.window_spec, self.replay.treedef)))
+            self.replay_update = self.build_replay_update(self.cfg)
             # observability: audited by metrics JSONL (replay_* fields)
             self.replay_stats = {'dropped_episodes': 0,
                                  'windows_ingested': 0,
@@ -243,6 +233,23 @@ class Trainer:
         self.last_steps_per_sec = 0.0
         self._profile_dir = args.get('profile_dir') or ''
         self._profiled = False
+
+    def build_replay_update(self, cfg: LossConfig):
+        """The fused K-step replay trainer for ``cfg`` — the ONE place its
+        geometry is defined (the ingest gate rebuilds it when the device
+        'turn' layout serves an observation=True config)."""
+        from .ops.train_step import build_replay_update
+        return build_replay_update(
+            self.wrapper.module, cfg, capacity=self.replay.capacity,
+            batch_size=self.args['batch_size'], num_steps=self.fused_steps,
+            default_lr=self.default_lr, mesh=self.mesh,
+            # window shapes resolved at trace time (first update): by
+            # then either the windower ring (device ingest) or the
+            # DeviceReplay (host push) has seen its first windows
+            spec_fn=lambda: (
+                (self.windower.window_spec, None)
+                if getattr(self, 'windower', None) is not None
+                else (self.replay.window_spec, self.replay.treedef)))
 
     def _lr(self) -> float:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
@@ -909,9 +916,28 @@ class Learner:
             simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
             if simultaneous and not args['turn_based_training']:
                 ingest_mode = 'solo'
-            elif (not simultaneous and args['turn_based_training']
-                  and not args['observation']):
+            elif not simultaneous and args['turn_based_training']:
+                # observation=True is admitted too: every env records only
+                # the acting seat per ply (``observers()`` defaults empty,
+                # reference environment.py:84), so the compact 'turn'
+                # window layout computes training math identical to the
+                # wide (B,T,P) observation layout — the device loss just
+                # runs with observation=False to match the layout
+                # (equivalence pinned by tests/test_turn_layout_parity.py)
                 ingest_mode = 'turn'
+
+        # the loss config the DEVICE pipelines train with: identical to
+        # the host trainer's except when 'turn' ingest serves an
+        # observation=True config (see the gate comment above)
+        tr = self.trainer
+        tr.device_cfg = tr.cfg
+        if ingest_mode == 'turn' and args['observation']:
+            tr.device_cfg = tr.cfg._replace(observation=False)
+            if tr.replay is not None:
+                # the threaded replay trainer samples windower rows in the
+                # compact layout too — rebuild its fused K-step program
+                # with the matching cfg (nothing traced yet at this point)
+                tr.replay_update = tr.build_replay_update(tr.device_cfg)
 
         opponents = args.get('eval', {}).get('opponent', []) or ['random']
 
@@ -1089,7 +1115,7 @@ class Learner:
         sgd_steps = int(args.get('sgd_steps_per_chunk') or 16)   # doc: config.py
         tr.windower = windower   # ring occupancy reporting
         fp = FusedPipeline(
-            env_mod, actor, tr.cfg, windower, args,
+            env_mod, actor, tr.device_cfg, windower, args,
             n_envs=args.get('generation_envs', 64),
             chunk_steps=int(args.get('device_chunk_steps') or 16),
             sgd_steps=sgd_steps, batch_size=args['batch_size'],

@@ -92,6 +92,41 @@ def test_geese_fused_pipeline_learner(tmp_path, capsys):
 
 
 @pytest.mark.timeout(600)
+def test_geister_fused_pipeline_learner(tmp_path, capsys):
+    """Geister (turn-based, observation=True, recurrent DRC, dict
+    observations) now runs the FUSED pipeline: the ingest gate admits
+    observation=True via the compact 'turn' layout (equivalence proven by
+    tests/test_turn_layout_parity.py), and the windower handles the
+    pytree observation. This pins geister's sample reuse to
+    sgd_steps_per_chunk instead of the threaded trainer's free spin."""
+    from handyrl_tpu.models.geister import GeisterNet
+
+    raw = {
+        'env_args': {'env': 'Geister'},
+        'train_args': {
+            'turn_based_training': True, 'observation': True,
+            'gamma': 0.9, 'forward_steps': 4, 'burn_in_steps': 2,
+            'compress_steps': 2, 'batch_size': 8, 'update_episodes': 8,
+            'minimum_episodes': 8, 'epochs': 2, 'generation_envs': 8,
+            'num_batchers': 1, 'device_generation': True,
+            'device_replay': True, 'sgd_steps_per_chunk': 2,
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    args = apply_defaults(raw)
+    learner = Learner(args=args,
+                      net=GeisterNet(filters=8, drc_layers=1))
+    learner.run()
+    out = capsys.readouterr().out
+    assert 'fused device pipeline' in out and '(turn mode' in out
+    assert learner.model_epoch == 2
+    assert learner.trainer.steps > 0
+    assert learner.trainer.device_cfg.observation is False
+    assert learner.trainer.cfg.observation is True
+    assert (tmp_path / 'models' / '2.ckpt').exists()
+
+
+@pytest.mark.timeout(600)
 def test_fused_pipeline_resume(tmp_path, capsys):
     args = apply_defaults(_ttt_raw(tmp_path))
     learner = Learner(args=args)
