@@ -72,6 +72,21 @@ ROWS = {
                        'device_generation': True, 'device_replay': True,
                        'device_chunk_steps': 32, 'eval_envs': 32},
     },
+    # Geister through the FUSED pipeline (round 4): observation=True rides
+    # the compact turn layout, so sample reuse is PINNED by
+    # sgd_steps_per_chunk instead of free-spinning with the threaded
+    # trainer (the round-3 quality-gap suspect). Reuse here ~= 4 * 32
+    # samples per ~40-window chunk ~= 3x, near the reference's ~1x.
+    'geister-fused': {
+        'env_args': {'env': 'Geister'},
+        'train_args': {'batch_size': 32, 'forward_steps': 16,
+                       'burn_in_steps': 4, 'update_episodes': 100,
+                       'minimum_episodes': 200, 'generation_envs': 32,
+                       'observation': True,
+                       'device_generation': True, 'device_replay': True,
+                       'device_chunk_steps': 32, 'eval_envs': 32,
+                       'sgd_steps_per_chunk': 4},
+    },
     'geese': {
         'env_args': {'env': 'HungryGeese'},
         'train_args': {'batch_size': 64, 'forward_steps': 16,
