@@ -5,7 +5,8 @@ throughput (episodes/sec, SGD steps/sec) and the aggregate win rate vs
 random over the last 5 epochs, appending JSON rows to benchmarks.jsonl.
 
 Usage: python scripts/run_benchmark_matrix.py [ROW ...] [--epochs N]
-Rows: ttt-td ttt-device ttt-vtrace geister geese geese-device
+Rows: ttt-td ttt-device ttt-device-mesh8 ttt-vtrace geister
+      geister-device geister-fused geese geese-device
 """
 
 import json

@@ -237,3 +237,49 @@ def test_ingest_fills_ring_and_counts_episodes():
     assert np.all(got['episode_mask'] == 1.0)
     # counts reset after each done
     assert np.all(np.asarray(state['counts']) == 0)
+
+
+def test_ingest_with_pytree_observations():
+    """Dict observations (geister's {'scalar','board'}) flow through the
+    windower: history buffers map over leaves, ring rows use dotted keys,
+    and unflatten_rows rebuilds the nested batch pytree."""
+    K, N, A, P, S = 6, 2, 3, 2, 3
+    rng = np.random.RandomState(2)
+    records = {
+        'obs': {'scalar': jnp.asarray(rng.rand(K, N, 5).astype(np.float32)),
+                'board': jnp.asarray(
+                    rng.rand(K, N, 2, 2, 2).astype(np.float32))},
+        'prob': jnp.asarray(rng.uniform(0.2, 1, (K, N)).astype(np.float32)),
+        'action': jnp.asarray(rng.randint(0, A, (K, N)).astype(np.int32)),
+        'amask': jnp.asarray(np.zeros((K, N, A), np.float32)),
+        'value': jnp.asarray(rng.rand(K, N, 1).astype(np.float32)),
+        'player': jnp.asarray((np.indices((K, N))[0] % P).astype(np.int32)),
+        'done': jnp.asarray((np.indices((K, N))[0] % S) == S - 1),
+        'outcome': jnp.asarray(
+            np.tile(np.array([1., -1.], np.float32), (K, N, 1))),
+    }
+    wd = DeviceWindower(mode='turn', fs=2, bi=0, max_steps=8, windows_cap=2,
+                        capacity=32, num_players=P, gamma=GAMMA,
+                        has_reward=False)
+    state = wd.init_state(records)
+    ring = wd.init_ring(records)
+    assert 'observation.scalar' in ring and 'observation.board' in ring
+    state, ring, cursor, size, key, n_done, n_windows = wd.ingest(
+        records, state, ring, jnp.int32(0), jnp.int32(0),
+        jax.random.PRNGKey(0))
+    assert int(n_done) == 4 and int(size) == 4
+    got = wd.unflatten_rows(
+        jax.tree_util.tree_map(lambda b: np.asarray(b[:4]), ring))
+    # nested batch pytree restored, window shapes intact
+    assert set(got['observation']) == {'scalar', 'board'}
+    assert got['observation']['scalar'].shape == (4, 2, 1, 5)
+    assert got['observation']['board'].shape == (4, 2, 1, 2, 2, 2)
+    assert got['turn_mask'].shape == (4, 2, P, 1)
+    # stored board content matches the recorded plies for a full window:
+    # env 0's first episode occupies plies 0..2; window start is 0 or 1
+    src = np.asarray(records['obs']['board'])[:, 0]
+    win = got['observation']['board'][:, :, 0]
+    found = any(
+        np.allclose(win[i], src[st:st + 2])
+        for i in range(4) for st in (0, 1))
+    assert found
