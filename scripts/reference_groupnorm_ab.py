@@ -1,0 +1,146 @@
+"""Isolate the GroupNorm-vs-BatchNorm suspect in the Geister quality gap.
+
+Runs the UNMODIFIED reference (PYTHONPATH=/root/reference, its own torch
+trainer) except that ``torch.nn.BatchNorm2d`` is replaced — via a
+sitecustomize shim, the reference tree itself is never touched — with a
+GroupNorm of the same group rule this repo's models use
+(min(8, channels)). If the reference's geister quality at ~1k episodes
+drops from its measured 0.661 toward the 0.45–0.48 this repo reaches,
+the normalization substitution explains the gap (and the fix here is a
+train-mode BatchNorm with batch_stats threaded through TrainState);
+if it stays ≈ 0.66, normalization is exonerated.
+
+Run: python scripts/reference_groupnorm_ab.py [--epochs N] [--deadline S]
+Appends one row (implementation: 'reference+groupnorm') to
+benchmarks.jsonl.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = '/root/reference'
+
+SHIM = r'''
+# sitecustomize: swap BatchNorm2d for GroupNorm(min(8, C)) process-wide.
+# Imported automatically by Python at startup (site module).
+import torch.nn as _nn
+
+class _GN2d(_nn.GroupNorm):
+    def __init__(self, num_features, *a, **k):
+        super().__init__(min(8, num_features), num_features)
+
+_nn.BatchNorm2d = _GN2d
+'''
+
+CONFIG = '''env_args:
+    env: 'Geister'
+
+train_args:
+    turn_based_training: True
+    observation: True
+    gamma: 0.8
+    forward_steps: 16
+    burn_in_steps: 4
+    compress_steps: 4
+    entropy_regularization: 0.1
+    entropy_regularization_decay: 0.1
+    update_episodes: 100
+    batch_size: 32
+    minimum_episodes: 200
+    maximum_episodes: 100000
+    epochs: %(epochs)d
+    num_batchers: 2
+    eval_rate: 0.1
+    worker:
+        num_parallel: 6
+    lambda: 0.7
+    policy_target: 'TD'
+    value_target: 'TD'
+    eval:
+        opponent: ['random']
+    seed: 0
+    restart_epoch: 0
+
+worker_args:
+    server_address: ''
+    num_parallel: 8
+'''
+
+_WIN_RE = re.compile(r'win rate(?: \(\w+\))? = ([\d.]+) \(([\d.]+) / (\d+)\)')
+_EPOCH_RE = re.compile(r'^epoch (\d+)$')
+
+
+def main():
+    epochs, deadline = 10, 3300
+    argv = iter(sys.argv[1:])
+    for a in argv:
+        key, _, val = a.partition('=')
+        if key in ('--epochs', '--deadline') and not val:
+            val = next(argv)
+        if key == '--epochs':
+            epochs = int(val)
+        elif key == '--deadline':
+            deadline = int(val)
+        else:
+            raise SystemExit('unknown argument %r' % a)
+
+    scratch = tempfile.mkdtemp(prefix='ref_gn_geister_')
+    with open(os.path.join(scratch, 'config.yaml'), 'w') as f:
+        f.write(CONFIG % {'epochs': epochs})
+    shim_dir = os.path.join(scratch, 'shim')
+    os.makedirs(shim_dir)
+    with open(os.path.join(shim_dir, 'sitecustomize.py'), 'w') as f:
+        f.write(SHIM)
+    log_path = os.path.join(scratch, 'train.log')
+
+    env = dict(os.environ,
+               PYTHONPATH=shim_dir + os.pathsep + REFERENCE,
+               OMP_NUM_THREADS='1')
+    t0 = time.time()
+    with open(log_path, 'w') as log:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REFERENCE, 'main.py'), '--train'],
+            cwd=scratch, env=env, stdout=log, stderr=subprocess.STDOUT)
+        try:
+            proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    wall = time.time() - t0
+
+    text = open(log_path, errors='replace').read()
+    assert 'GroupNorm' in open(
+        os.path.join(shim_dir, 'sitecustomize.py')).read()
+    rates = [(float(m.group(1)), int(m.group(3)))
+             for m in _WIN_RE.finditer(text)]
+    epochs_seen = [int(m.group(1)) for line in text.splitlines()
+                   for m in [_EPOCH_RE.match(line)] if m] or [0]
+    last5 = rates[-5:]
+    games = sum(n for _, n in last5)
+    win_rate = (sum(r * n for r, n in last5) / games) if games else None
+
+    row = {
+        'implementation': 'reference+groupnorm', 'row': 'geister',
+        'epochs': epochs, 'epochs_seen': max(epochs_seen),
+        'wall_s': round(wall, 1),
+        'win_rate_vs_random_last5': (round(win_rate, 3)
+                                     if win_rate is not None else None),
+        'eval_games': games, 'log': log_path,
+        'time': time.strftime('%Y-%m-%d %H:%M:%S'),
+    }
+    print(json.dumps(row), flush=True)
+    with open(os.path.join(REPO, 'benchmarks.jsonl'), 'a') as f:
+        f.write(json.dumps(row) + '\n')
+
+
+if __name__ == '__main__':
+    main()
