@@ -25,11 +25,70 @@ def to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(x, -3, -1)
 
 
+class BatchStatsNorm(nn.Module):
+    """Train-mode BatchNorm semantics as a PURE function: per-channel
+    normalization by the CURRENT batch's statistics over every non-channel
+    axis, with learned scale/bias — no running averages, so nothing
+    mutable threads through scan/jit/checkpoints.
+
+    Why it exists: the round-4 Geister quality forensics measured the
+    GroupNorm-for-BatchNorm substitution as THE cause of the quality gap
+    vs the reference (its nn.BatchNorm2d stem/heads, reference
+    geister.py:107,122 — swap them for GroupNorm and the reference drops
+    from 0.661 to 0.486 at ~1k episodes, exactly this repo's level; see
+    BENCHMARKS.md). Batch statistics in the training forward are the
+    learning-dynamics ingredient; this block provides them without
+    running-stats state.
+
+    Inference caveats: the training/benchmark paths (device + batched
+    evaluators and generators) run batched env vectors, so inference
+    statistics match training's regime. The SEQUENTIAL host paths —
+    worker-mode Evaluator/exec_match and NetworkAgent (evaluation.py) —
+    infer at B=1, where this block degrades to per-sample (instance)
+    statistics: a different network function than trained (the torch
+    reference uses running averages there instead). Window-tail pad rows
+    also enter the statistics during training, exactly as they entered
+    the reference's train-mode BatchNorm.
+    """
+    dtype: jnp.dtype = jnp.float32
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        axes = tuple(range(x.ndim - 1))
+        # statistics in float32 regardless of activation dtype (bf16
+        # mean/var over ~1k elements loses the variance to cancellation;
+        # flax's own norm layers upcast the same way)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = ((xf - mean) / jnp.sqrt(var + self.eps)).astype(self.dtype)
+        scale = self.param('scale', nn.initializers.ones, (c,), self.dtype)
+        bias = self.param('bias', nn.initializers.zeros, (c,), self.dtype)
+        return y * scale + bias
+
+
+def make_norm(kind: str, filters: int, dtype) -> nn.Module:
+    """'group' (stateless default) | 'batch' (reference-parity batch
+    statistics, BatchStatsNorm above) | 'layer'."""
+    if kind == 'batch':
+        return BatchStatsNorm(dtype=dtype)
+    if kind == 'layer':
+        return nn.LayerNorm(dtype=dtype)
+    if kind == 'group':
+        return nn.GroupNorm(num_groups=min(8, filters), dtype=dtype)
+    # never fall back silently: a typo'd kind reinstating GroupNorm would
+    # quietly reintroduce the exact regression 'batch' exists to fix
+    raise ValueError('unknown norm kind %r' % (kind,))
+
+
 class ConvBlock(nn.Module):
-    """3x3 conv + optional GroupNorm, operating on NHWC."""
+    """3x3 conv + optional normalization, operating on NHWC."""
     filters: int
     kernel: int = 3
     norm: bool = True
+    norm_kind: str = 'group'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -37,7 +96,7 @@ class ConvBlock(nn.Module):
         x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='SAME',
                     use_bias=not self.norm, dtype=self.dtype)(x)
         if self.norm:
-            x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=self.dtype)(x)
+            x = make_norm(self.norm_kind, self.filters, self.dtype)(x)
         return x
 
 
@@ -82,12 +141,16 @@ class ScalarHead(nn.Module):
     """1x1 conv + norm + relu -> dense scalar(s) (no bias)."""
     filters: int
     outputs: int = 1
+    norm_kind: str = 'group1'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        h = nn.GroupNorm(num_groups=1, dtype=self.dtype)(h)
+        if self.norm_kind == 'group1':
+            h = nn.GroupNorm(num_groups=1, dtype=self.dtype)(h)
+        else:
+            h = make_norm(self.norm_kind, self.filters, self.dtype)(h)
         h = nn.relu(h)
         h = h.reshape(*h.shape[:-3], -1)
         return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)

@@ -20,6 +20,10 @@ class GeisterNet(nn.Module):
     filters: int = 32
     drc_layers: int = 3
     drc_repeats: int = 3
+    # batch statistics in stem + scalar heads: the reference's BatchNorm2d
+    # placement (geister.py:107,122), measured decisive for learning speed
+    # (BENCHMARKS.md round-4 Geister quality-gap section)
+    norm_kind: str = 'batch'
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
@@ -39,7 +43,8 @@ class GeisterNet(nn.Module):
                                  board.shape[:-1] + scalar.shape[-1:])
         x = jnp.concatenate([board, s_map], axis=-1)     # (..., 6, 6, 25)
 
-        h = nn.relu(ConvBlock(self.filters, dtype=self.dtype)(x))
+        h = nn.relu(ConvBlock(self.filters, norm_kind=self.norm_kind,
+                              dtype=self.dtype)(x))
         body = DRC(self.drc_layers, self.filters,
                    num_repeats=self.drc_repeats, dtype=self.dtype)
         if hidden is None:
@@ -52,7 +57,9 @@ class GeisterNet(nn.Module):
         p_set = nn.Dense(70, dtype=self.dtype)(turn_color)
         policy = jnp.concatenate([p_move, p_set], axis=-1)
 
-        value = jnp.tanh(ScalarHead(2, 1, dtype=self.dtype)(h))
-        ret = ScalarHead(2, 1, dtype=self.dtype)(h)
+        value = jnp.tanh(ScalarHead(2, 1, norm_kind=self.norm_kind,
+                                    dtype=self.dtype)(h))
+        ret = ScalarHead(2, 1, norm_kind=self.norm_kind,
+                         dtype=self.dtype)(h)
         return {'policy': policy, 'value': value, 'return': ret,
                 'hidden': next_hidden}

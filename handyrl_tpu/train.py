@@ -921,9 +921,13 @@ class Learner:
                 # the acting seat per ply (``observers()`` defaults empty,
                 # reference environment.py:84), so the compact 'turn'
                 # window layout computes training math identical to the
-                # wide (B,T,P) observation layout — the device loss just
-                # runs with observation=False to match the layout
-                # (equivalence pinned by tests/test_turn_layout_parity.py)
+                # wide (B,T,P) observation layout for per-sample models
+                # (gradient-level proof: tests/test_turn_layout_parity.py);
+                # with batch-statistics norms the compact layout's
+                # statistics exclude the wide layout's zeroed non-acting
+                # seat rows (window-tail pad rows still enter, as in the
+                # reference's train-mode BatchNorm). The device loss runs
+                # with observation=False to match the layout.
                 ingest_mode = 'turn'
 
         # the loss config the DEVICE pipelines train with: identical to
