@@ -344,7 +344,10 @@ class Environment(BaseEnvironment):
 
     def net(self):
         from ..models.geister import GeisterNet
-        return GeisterNet()
+        # env_args: {'norm_kind': 'batch'} surfaces the round-4 norm
+        # investigation knob (BENCHMARKS.md Geister quality-gap section)
+        # without a source edit
+        return GeisterNet(norm_kind=self.args.get('norm_kind', 'group'))
 
     def __str__(self) -> str:
         def glyph(piece):

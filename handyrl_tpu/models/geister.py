@@ -20,10 +20,15 @@ class GeisterNet(nn.Module):
     filters: int = 32
     drc_layers: int = 3
     drc_repeats: int = 3
-    # batch statistics in stem + scalar heads: the reference's BatchNorm2d
-    # placement (geister.py:107,122), measured decisive for learning speed
-    # (BENCHMARKS.md round-4 Geister quality-gap section)
-    norm_kind: str = 'batch'
+    # 'batch' = the reference's BatchNorm2d placement (geister.py:107,122)
+    # as pure batch statistics. The round-4 forensics PROVED normalization
+    # causal on the torch side (reference drops 0.661 -> 0.486 when its
+    # BatchNorm is swapped for GroupNorm), but this pure-function variant
+    # alone measured tied with GroupNorm here (0.452 vs 0.466 at ~1k
+    # episodes, BENCHMARKS.md) — the remaining delta is likely the
+    # running-statistics eval the reference uses. Default stays 'group'
+    # until the full semantics close the gap on this side.
+    norm_kind: str = 'group'
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
@@ -43,6 +48,10 @@ class GeisterNet(nn.Module):
                                  board.shape[:-1] + scalar.shape[-1:])
         x = jnp.concatenate([board, s_map], axis=-1)     # (..., 6, 6, 25)
 
+        # 'group' maps the heads to their original 'group1' (num_groups=1)
+        # so the default reproduces the measured baseline configuration
+        # exactly; only 'batch' switches the heads' statistics
+        head_norm = 'group1' if self.norm_kind == 'group' else self.norm_kind
         h = nn.relu(ConvBlock(self.filters, norm_kind=self.norm_kind,
                               dtype=self.dtype)(x))
         body = DRC(self.drc_layers, self.filters,
@@ -57,9 +66,9 @@ class GeisterNet(nn.Module):
         p_set = nn.Dense(70, dtype=self.dtype)(turn_color)
         policy = jnp.concatenate([p_move, p_set], axis=-1)
 
-        value = jnp.tanh(ScalarHead(2, 1, norm_kind=self.norm_kind,
+        value = jnp.tanh(ScalarHead(2, 1, norm_kind=head_norm,
                                     dtype=self.dtype)(h))
-        ret = ScalarHead(2, 1, norm_kind=self.norm_kind,
+        ret = ScalarHead(2, 1, norm_kind=head_norm,
                          dtype=self.dtype)(h)
         return {'policy': policy, 'value': value, 'return': ret,
                 'hidden': next_hidden}

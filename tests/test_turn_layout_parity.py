@@ -13,12 +13,13 @@ recurrent hidden advances identically in both (omask-gated carry).
 
 Scope: the identity holds for PER-SAMPLE models (GroupNorm/LayerNorm —
 each row's output depends only on that row). With batch-statistics
-normalization (GeisterNet's round-4 default, models/blocks.py
-BatchStatsNorm) the layouts intentionally differ: the wide layout's
-statistics include the zero rows of non-acting seats (as the torch
-reference's train-mode BatchNorm did), the compact layout's cover real
-rows only — the better-conditioned statistics. The last test pins that
-difference so it stays a documented choice, not an accident."""
+normalization (models/blocks.py BatchStatsNorm, GeisterNet's
+norm_kind='batch' investigation setting) the layouts intentionally
+differ: the wide layout's statistics include the zeroed non-acting-seat
+rows (as the torch reference's train-mode BatchNorm did) while the
+compact layout's do not (window-tail pad rows still enter both). The
+last test pins that difference so it stays a documented choice, not an
+accident."""
 
 import random
 
@@ -148,7 +149,7 @@ def test_wide_and_compact_no_burn_in(wide_batch_and_params):
 
 
 def test_batch_stats_norm_layouts_differ_by_design(wide_batch_and_params):
-    """With BatchStatsNorm (GeisterNet default) the compact layout's
+    """With BatchStatsNorm (norm_kind='batch') the compact layout's
     statistics exclude the wide layout's zero rows — the losses MUST
     differ; if this ever starts passing with equality, the norm silently
     stopped using batch statistics."""
@@ -165,3 +166,11 @@ def test_batch_stats_norm_layouts_differ_by_design(wide_batch_and_params):
         wrapper, compact, LossConfig.from_args(_args(False)))
     assert np.isfinite(float(loss_w)) and np.isfinite(float(loss_c))
     assert abs(float(loss_w) - float(loss_c)) > 1e-6
+
+
+def test_norm_kind_env_args_plumbing():
+    """env_args {'norm_kind': 'batch'} reaches GeisterNet without a source
+    edit (the BENCHMARKS round-5 A/B path)."""
+    env = make_env({'env': 'Geister', 'norm_kind': 'batch'})
+    assert env.net().norm_kind == 'batch'
+    assert make_env(ENV_ARGS).net().norm_kind == 'group'
