@@ -278,8 +278,11 @@ class Environment(BaseEnvironment):
         return best[1]
 
     def net(self):
+        # env_args {'norm_kind': 'batch'} selects full BatchNorm in the
+        # stem + all blocks (reference TorusConv2d's nn.BatchNorm2d,
+        # hungry_geese.py:23-35,43-44) — the round-5 norm A/B knob
         from ...models.geese import GeeseNet
-        return GeeseNet()
+        return GeeseNet(norm_kind=self.args.get('norm_kind', 'group'))
 
     def __str__(self) -> str:
         grid = [['.'] * C for _ in range(R)]

@@ -10,6 +10,7 @@ architecture name — never as pickled code.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict
 
@@ -24,6 +25,21 @@ from .utils.tree import map_structure
 
 def _to_numpy(x):
     return jax.tree_util.tree_map(np.asarray, x)
+
+
+def module_config(module) -> Dict[str, Any]:
+    """Non-default, wire-safe (str/int/float/bool) dataclass fields of a
+    flax module. dtype-like fields are intentionally skipped: they change
+    numerics, not the param-tree structure, and the training side pins them
+    explicitly."""
+    config: Dict[str, Any] = {}
+    for f in dataclasses.fields(module):
+        if f.name in ('parent', 'name'):
+            continue
+        v = getattr(module, f.name)
+        if isinstance(v, (str, int, float, bool)) and v != f.default:
+            config[f.name] = v
+    return config
 
 
 @functools.lru_cache(maxsize=64)
@@ -64,6 +80,20 @@ class ModelWrapper:
     # -- inference --------------------------------------------------------
     def inference(self, obs, hidden=None) -> Dict[str, Any]:
         """Single sample: numpy in, numpy out, batch dim handled here."""
+        if getattr(self.module, 'norm_kind', None) == 'batchstats' \
+                and not getattr(self, '_warned_b1', False):
+            # ADVICE r4: the pure batch-statistics investigation norm
+            # degrades to per-sample (instance) statistics at B=1 — a
+            # different network function than trained. norm_kind='batch'
+            # (full BatchNorm, running averages) does not have this trap.
+            import warnings
+            warnings.warn(
+                "norm_kind='batchstats' model used on a sequential B=1 "
+                "inference path: normalization falls back to per-sample "
+                "statistics, a different function than trained. Use "
+                "norm_kind='batch' (running-average BatchNorm) for "
+                "sequential host evaluation.", RuntimeWarning)
+            self._warned_b1 = True
         self.ensure_params(obs)
         obs_b = map_structure(lambda v: None if v is None else jnp.asarray(v)[None], obs)
         hidden_b = None
@@ -88,12 +118,23 @@ class ModelWrapper:
 
     # -- wire format ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
-        """Architecture name + raw param bytes (safe to ship cross-process)."""
+        """Architecture name + non-default constructor config + raw param
+        bytes (safe to ship cross-process). The config entry carries plain
+        (str/int/float/bool) dataclass fields that differ from the
+        architecture's defaults — e.g. GeisterNet(norm_kind='batch') — so a
+        worker rebuilding the model from the wire gets the same module
+        function, not the registry default (param trees differ between norm
+        kinds; silently rebuilding the default would fail deserialization
+        at best)."""
         assert self.params is not None, 'snapshot of uninitialized model'
-        return {
+        snap = {
             'architecture': model_zoo.architecture_name(self.module),
             'params': serialization.to_bytes(self.params),
         }
+        config = module_config(self.module)
+        if config:
+            snap['config'] = config
+        return snap
 
     @classmethod
     def from_snapshot(cls, snap: Dict[str, Any], example_obs,
@@ -104,7 +145,7 @@ class ModelWrapper:
         the module.init trace — callers that materialize many snapshots of
         one architecture (e.g. the worker model vault, every epoch) pay the
         init exactly once."""
-        module = model_zoo.build(snap['architecture'])
+        module = model_zoo.build(snap['architecture'], **snap.get('config', {}))
         wrapper = cls(module)
         if params_template is None:
             wrapper.ensure_params(example_obs)

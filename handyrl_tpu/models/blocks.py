@@ -7,9 +7,13 @@ Model convention (framework-wide):
   * observations arrive channel-first (C, H, W) exactly as environments emit
     them (parity with the reference protocol); blocks transpose to NHWC at
     the input edge because that is the layout XLA tiles best onto the MXU.
-  * normalization is GroupNorm, not BatchNorm: stateless, no running-stats
-    collections to thread through lax.scan or checkpoints, and no cross-chip
-    batch-stat sync — the TPU-idiomatic choice for small conv nets.
+  * normalization defaults to GroupNorm (stateless — nothing mutable to
+    thread through lax.scan or checkpoints, no cross-chip batch-stat sync);
+    nets that measurably need the reference's BatchNorm learning dynamics
+    (GeisterNet — the round-4 forensics) take ``norm_kind='batch'``, a full
+    flax nn.BatchNorm whose ``batch_stats`` collection the trainer threads
+    through the forward (ops/losses.py) and whose running averages every
+    inference path reads via the plain ``module.apply`` default.
 """
 
 from __future__ import annotations
@@ -26,7 +30,9 @@ def to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
 
 
 class BatchStatsNorm(nn.Module):
-    """Train-mode BatchNorm semantics as a PURE function: per-channel
+    """(norm_kind='batchstats' — the round-4 investigation variant, kept
+    for the A/B record; 'batch' is now full nn.BatchNorm with running
+    averages.) Train-mode BatchNorm semantics as a PURE function: per-channel
     normalization by the CURRENT batch's statistics over every non-channel
     axis, with learned scale/bias — no running averages, so nothing
     mutable threads through scan/jit/checkpoints.
@@ -69,10 +75,30 @@ class BatchStatsNorm(nn.Module):
         return y * scale + bias
 
 
-def make_norm(kind: str, filters: int, dtype) -> nn.Module:
-    """'group' (stateless default) | 'batch' (reference-parity batch
-    statistics, BatchStatsNorm above) | 'layer'."""
+def make_norm(kind: str, filters: int, dtype, train: bool = False) -> nn.Module:
+    """'group' (stateless default) | 'batch' (FULL reference-parity
+    BatchNorm: current-batch statistics in the training forward, running
+    averages served at inference — matches the reference's nn.BatchNorm2d
+    train/eval split, reference geister.py:107,122 + model.py:54) |
+    'batchstats' (the round-4 pure investigation variant above, batch
+    statistics with NO running averages) | 'layer'.
+
+    'batch' carries a mutable ``batch_stats`` collection: the training
+    forward must apply with ``mutable=['batch_stats']`` and ``train=True``
+    (ops/losses.py threads it, incl. through the recurrent scan); every
+    other apply reads the running averages, so the sequential B=1 host
+    paths (worker-mode Evaluator, NetworkAgent) see the SAME network
+    function as the batched ones — the trap BatchStatsNorm had.
+
+    torch-parity notes: momentum 0.9 here == torch's 0.1 (flax weights the
+    old average, torch the new term); eps 1e-5 matches; flax updates the
+    running variance with the biased estimator where torch uses unbiased —
+    an O(1/batch-elements) difference, negligible at conv feature-map
+    sizes."""
     if kind == 'batch':
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                            epsilon=1e-5, dtype=dtype)
+    if kind == 'batchstats':
         return BatchStatsNorm(dtype=dtype)
     if kind == 'layer':
         return nn.LayerNorm(dtype=dtype)
@@ -92,11 +118,11 @@ class ConvBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='SAME',
                     use_bias=not self.norm, dtype=self.dtype)(x)
         if self.norm:
-            x = make_norm(self.norm_kind, self.filters, self.dtype)(x)
+            x = make_norm(self.norm_kind, self.filters, self.dtype, train)(x)
         return x
 
 
@@ -109,17 +135,18 @@ class TorusConv(nn.Module):
     filters: int
     kernel: int = 3
     norm: bool = True
+    norm_kind: str = 'group'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         kh, kw = self.kernel // 2, self.kernel // 2
         pad = [(0, 0)] * (x.ndim - 3) + [(kh, kh), (kw, kw), (0, 0)]
         x = jnp.pad(x, pad, mode='wrap')
         x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='VALID',
                     use_bias=not self.norm, dtype=self.dtype)(x)
         if self.norm:
-            x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=self.dtype)(x)
+            x = make_norm(self.norm_kind, self.filters, self.dtype, train)(x)
         return x
 
 
@@ -145,12 +172,12 @@ class ScalarHead(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
         if self.norm_kind == 'group1':
             h = nn.GroupNorm(num_groups=1, dtype=self.dtype)(h)
         else:
-            h = make_norm(self.norm_kind, self.filters, self.dtype)(h)
+            h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
         h = nn.relu(h)
         h = h.reshape(*h.shape[:-3], -1)
         return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)

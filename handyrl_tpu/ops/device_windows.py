@@ -45,27 +45,41 @@ def _take(hist_leaf, idxm):
 def flatten_window_keys(win: Dict[str, Any]) -> Dict[str, Any]:
     """Window dicts may carry a PYTREE observation (e.g. geister's
     {'scalar', 'board'}); the ring stores flat 2-D rows per leaf, so
-    nested leaves become dotted keys ('observation.board')."""
+    nested dict levels become dotted keys ('observation.board'), recursing
+    to arbitrary depth. Keys must not contain '.' (asserted — a dotted
+    env observation key would collide with the path encoding) and every
+    flattened value must be an array-like, so a deeper-than-expected
+    pytree fails HERE with a clear message, not later inside the ring."""
     out = {}
-    for k, v in win.items():
+
+    def walk(prefix, v):
         if isinstance(v, dict):
             for sk, sv in v.items():
-                out['%s.%s' % (k, sk)] = sv
+                assert '.' not in str(sk), (
+                    'observation key %r contains "." which is reserved for '
+                    'the ring\'s flattened-path encoding' % (sk,))
+                walk('%s.%s' % (prefix, sk) if prefix else str(sk), sv)
         else:
-            out[k] = v
+            assert hasattr(v, 'shape'), (
+                'window leaf %r is %r, not an array — unsupported pytree '
+                'node in the observation?' % (prefix, type(v)))
+            out[prefix] = v
+
+    for k, v in win.items():
+        walk(str(k), v)
     return out
 
 
 def unflatten_window_keys(win: Dict[str, Any]) -> Dict[str, Any]:
     """Inverse of flatten_window_keys — rebuilds the batch pytree the
-    loss consumes (batch['observation'] nested again)."""
+    loss consumes (batch['observation'] nested again, any depth)."""
     out: Dict[str, Any] = {}
     for k, v in win.items():
-        if '.' in k:
-            base, sub = k.split('.', 1)
-            out.setdefault(base, {})[sub] = v
-        else:
-            out[k] = v
+        parts = k.split('.')
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
     return out
 
 

@@ -65,16 +65,47 @@ def _fold_bt(x):
     return x.reshape((-1,) + x.shape[3:])
 
 
+def split_batch_stats(variables):
+    """Split a flax variables dict into (trainable collections, batch_stats
+    or None). Models without a ``batch_stats`` collection (every norm_kind
+    but 'batch') pass through unchanged."""
+    from collections.abc import Mapping
+    if isinstance(variables, Mapping) and 'batch_stats' in variables:
+        rest = {k: v for k, v in variables.items() if k != 'batch_stats'}
+        return rest, variables['batch_stats']
+    return variables, None
+
+
 def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
-                       cfg: LossConfig) -> Dict[str, jnp.ndarray]:
+                       cfg: LossConfig, batch_stats=None):
     """Run the net over a training window; returns time-major-stacked outputs
-    shaped (B, T, P, ...) with policy/value/return masking applied."""
+    shaped (B, T, P, ...) with policy/value/return masking applied.
+
+    ``batch_stats`` engages reference-BatchNorm training semantics
+    (norm_kind='batch', reference model.py:54 train/eval split): the net is
+    applied with ``train=True, mutable=['batch_stats']`` so normalization
+    uses the CURRENT batch's statistics while the running averages advance
+    — once per window for feed-forward nets (the fold makes the statistics
+    span B*T*P, exactly like the reference's flattened forward) and once
+    per scan step for recurrent nets (the reference's T per-timestep
+    BatchNorm calls, burn-in included: torch updates running stats under
+    no_grad too). The return becomes ``(outputs, new_batch_stats)``; the
+    updated stats are stop_gradient'd (write-only during training — the
+    forward reads only batch statistics in train mode)."""
     observations = batch['observation']
     B, T, P_obs = batch['action'].shape[:3]
 
+    def net(bs, obs_in, h_in):
+        """One apply in the right mode; returns (out_dict, new_bs)."""
+        if bs is None:
+            return dict(apply_fn(params, obs_in, h_in)), None
+        out, mut = apply_fn({**dict(params), 'batch_stats': bs}, obs_in,
+                            h_in, train=True, mutable=['batch_stats'])
+        return dict(out), lax.stop_gradient(mut['batch_stats'])
+
     if hidden is None:
         obs = tmap(_fold_bt, observations)
-        outputs = apply_fn(params, obs, None)
+        outputs, new_bs = net(batch_stats, obs, None)
         outputs = {k: v.reshape((B, T, P_obs) + v.shape[1:])
                    for k, v in outputs.items() if k != 'hidden' and v is not None}
     else:
@@ -82,12 +113,13 @@ def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
         omask_tm = jnp.moveaxis(batch['observation_mask'], 1, 0)       # (T, B, P, 1)
 
         def step(carry, x):
+            h_carry, bs = carry
             obs_t, omask_t = x
             # gate each player's hidden by whether they observed this step
             def gate(h):
                 m = omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
                 return h * m
-            gated = tmap(gate, carry)
+            gated = tmap(gate, h_carry)
             if cfg.turn_based_training and not cfg.observation:
                 # only the turn player observed: summing the player axis
                 # selects their state (others were zeroed)
@@ -96,7 +128,7 @@ def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
             else:
                 h_in = tmap(lambda h: h.reshape((-1,) + h.shape[2:]), gated)
                 obs_in = tmap(lambda o: o.reshape((-1,) + o.shape[2:]), obs_t)
-            out = dict(apply_fn(params, obs_in, h_in))
+            out, bs = net(bs, obs_in, h_in)
             next_h = out.pop('hidden')
             out = {k: v.reshape((B, P_obs) + v.shape[1:])
                    for k, v in out.items() if v is not None}
@@ -105,16 +137,18 @@ def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
             def merge(h, nh):
                 m = omask_t.reshape(omask_t.shape[:2] + (1,) * (h.ndim - 2))
                 return h * (1 - m) + nh * m
-            carry = tmap(merge, carry, next_h)
-            return carry, out
+            h_carry = tmap(merge, h_carry, next_h)
+            return (h_carry, bs), out
 
         bi = cfg.burn_in_steps
         if bi > 0:
             xs_burn = (tmap(lambda o: o[:bi], obs_tm), omask_tm[:bi])
-            hidden, _ = lax.scan(step, hidden, xs_burn)
+            (hidden, batch_stats), _ = lax.scan(
+                step, (hidden, batch_stats), xs_burn)
             hidden = lax.stop_gradient(hidden)
         xs_main = (tmap(lambda o: o[bi:], obs_tm), omask_tm[bi:])
-        _, outputs_tm = lax.scan(step, hidden, xs_main)
+        (_, new_bs), outputs_tm = lax.scan(step, (hidden, batch_stats),
+                                           xs_main)
         outputs = {k: jnp.moveaxis(v, 0, 1) for k, v in outputs_tm.items()}
 
         # re-attach zero outputs for burn-in steps so downstream slicing is
@@ -134,7 +168,9 @@ def forward_prediction(apply_fn, params, hidden, batch: Dict[str, Any],
             masked[k] = o - batch['action_mask']
         else:
             masked[k] = o * batch['observation_mask']
-    return masked
+    if batch_stats is None and new_bs is None:
+        return masked          # historical API: norm-stateless models
+    return masked, new_bs
 
 
 def _entropy(logits: jnp.ndarray) -> jnp.ndarray:
@@ -184,13 +220,23 @@ def optax_huber(pred: jnp.ndarray, target: jnp.ndarray, delta: float = 1.0
 
 
 def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
-                 cfg: LossConfig) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+                 cfg: LossConfig, batch_stats=None
+                 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Full pipeline: forward, targets, advantages, composed losses.
 
     Returns (total_loss, aux) where aux carries per-term sums and the data
-    count for the EMA lr schedule.
+    count for the EMA lr schedule. For norm_kind='batch' models the caller
+    may pass the full variables dict as ``params`` (the batch_stats
+    collection is split off here) or pass ``batch_stats`` explicitly; the
+    advanced running averages come back as ``aux['batch_stats']``.
     """
-    outputs = forward_prediction(apply_fn, params, init_hidden, batch, cfg)
+    if batch_stats is None:
+        params, batch_stats = split_batch_stats(params)
+    outputs = forward_prediction(apply_fn, params, init_hidden, batch, cfg,
+                                 batch_stats)
+    new_bs = None
+    if batch_stats is not None:
+        outputs, new_bs = outputs
 
     bi = cfg.burn_in_steps
     if bi > 0:
@@ -248,6 +294,8 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
     losses, dcnt = compose_losses(outputs, log_t, total_advantages, targets,
                                   batch, cfg)
     aux = {'losses': losses, 'data_count': dcnt}
+    if new_bs is not None:
+        aux['batch_stats'] = new_bs
     return losses['total'], aux
 
 

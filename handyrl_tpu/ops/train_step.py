@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from .losses import LossConfig, compute_loss
+from .losses import LossConfig, compute_loss, split_batch_stats
 from ..parallel.mesh import batch_sharding, replicated_sharding
 
 
@@ -38,8 +38,14 @@ def make_optimizer() -> optax.GradientTransformation:
 
 
 def init_train_state(params) -> TrainState:
+    """``params`` is the model's full flax variables dict. The optimizer
+    covers only the trainable collections — a norm_kind='batch' model's
+    ``batch_stats`` running averages advance by EMA in the forward
+    (losses.py), never by Adam (zero-grad moments + weight decay would
+    silently shrink them toward 0)."""
     opt = make_optimizer()
-    return TrainState(params=params, opt_state=opt.init(params),
+    trainable, _ = split_batch_stats(params)
+    return TrainState(params=params, opt_state=opt.init(trainable),
                       steps=jnp.zeros((), jnp.int32))
 
 
@@ -64,17 +70,29 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
     def update(state: TrainState, batch: Dict[str, Any], lr: jnp.ndarray
                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         init_hidden = init_hidden_for(batch)
+        trainable, batch_stats = split_batch_stats(state.params)
 
         def loss_fn(params):
-            return compute_loss(apply_fn, params, init_hidden, batch, cfg)
+            return compute_loss(apply_fn, params, init_hidden, batch, cfg,
+                                batch_stats=batch_stats)
 
-        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(trainable)
+        new_bs = aux.pop('batch_stats', None)
         if axis_name is not None:
             grads = jax.lax.psum(grads, axis_name)
             aux = jax.lax.psum(aux, axis_name)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            if new_bs is not None:
+                # each shard normalized by ITS batch slice's statistics
+                # (torch DataParallel BatchNorm semantics, what the
+                # reference trains with); averaging the advanced running
+                # stats keeps the replicated train state bit-identical
+                # across shards
+                new_bs = jax.lax.pmean(new_bs, axis_name)
+        updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
-        params = optax.apply_updates(state.params, updates)
+        params = optax.apply_updates(trainable, updates)
+        if new_bs is not None:
+            params = {**dict(params), 'batch_stats': new_bs}
         metrics = {**aux['losses'], 'data_count': aux['data_count']}
         new_state = TrainState(params=params, opt_state=opt_state,
                                steps=state.steps + 1)

@@ -1375,10 +1375,19 @@ class Learner:
                         snap = self.wrapper.snapshot()
                     else:
                         try:
+                            from .model import module_config
+                            from . import models as model_zoo
                             with open(self.model_path(model_id), 'rb') as f:
-                                snap = {'architecture':
-                                        self.wrapper.snapshot()['architecture'],
+                                snap = {'architecture': model_zoo
+                                        .architecture_name(self.wrapper.module),
                                         'params': f.read()}
+                            # non-default module config (e.g. GeisterNet
+                            # norm_kind='batch') must ride along or the
+                            # worker rebuilds the registry default, whose
+                            # param tree rejects these bytes
+                            config = module_config(self.wrapper.module)
+                            if config:
+                                snap['config'] = config
                         except OSError:
                             snap = self.wrapper.snapshot()
                     send_data.append(snap)

@@ -74,10 +74,14 @@ class ModelVault:
 
     def _admit(self, mid: int):
         snap = self._fetch(mid)
+        # template key includes the wire config: the same architecture with
+        # a different param-tree-shaping knob (e.g. GeisterNet norm_kind)
+        # must not reuse a structurally different template
+        key = (snap['architecture'], tuple(sorted(snap.get('config', {}).items())))
         wrapper = ModelWrapper.from_snapshot(
             snap, self._example_obs,
-            params_template=self._templates.get(snap['architecture']))
-        self._templates.setdefault(snap['architecture'], wrapper.params)
+            params_template=self._templates.get(key))
+        self._templates.setdefault(key, wrapper.params)
         model = RandomModel(wrapper, self._example_obs) if mid == 0 else wrapper
         while len(self._slots) >= self._capacity:
             self._slots.popitem(last=False)

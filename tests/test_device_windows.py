@@ -283,3 +283,30 @@ def test_ingest_with_pytree_observations():
         np.allclose(win[i], src[st:st + 2])
         for i in range(4) for st in (0, 1))
     assert found
+
+
+def test_flatten_window_keys_arbitrary_depth_roundtrip():
+    """ADVICE r4: deeper-than-one dict nesting must roundtrip (or fail
+    fast), not leak dict values into the ring."""
+    import pytest
+    from handyrl_tpu.ops.device_windows import (flatten_window_keys,
+                                                unflatten_window_keys)
+    win = {
+        'action': np.zeros((2, 3), np.int32),
+        'observation': {'board': np.ones((2, 4)),
+                        'aux': {'inner': np.full((2, 1), 7.0),
+                                'deep': {'leaf': np.zeros((2, 2))}}},
+    }
+    flat = flatten_window_keys(win)
+    assert set(flat) == {'action', 'observation.board',
+                         'observation.aux.inner',
+                         'observation.aux.deep.leaf'}
+    back = unflatten_window_keys(flat)
+    assert back['observation']['aux']['deep']['leaf'].shape == (2, 2)
+    np.testing.assert_array_equal(back['observation']['aux']['inner'],
+                                  win['observation']['aux']['inner'])
+
+    with pytest.raises(AssertionError, match='reserved'):
+        flatten_window_keys({'observation': {'bad.key': np.zeros(2)}})
+    with pytest.raises(AssertionError, match='not an array'):
+        flatten_window_keys({'observation': {'v': [1, 2, 3]}})
