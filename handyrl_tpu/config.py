@@ -35,6 +35,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'eval': {'opponent': ['random']},
     'seed': 0,
     'restart_epoch': 0,
+    'init_params': '',            # warm-start: load model params (a .ckpt snapshot of the SAME architecture) at epoch 0, fresh optimizer/episode counters — for measurement runs that need a late-stage policy (e.g. the replay-weighting A/B's long-episode regime)
     # --- TPU-native extensions (absent in the reference) ---
     'batched_generation': True,   # in-process vectorized self-play actors
     'generation_envs': 64,        # env count per batched actor

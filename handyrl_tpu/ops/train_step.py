@@ -82,11 +82,16 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
             grads = jax.lax.psum(grads, axis_name)
             aux = jax.lax.psum(aux, axis_name)
             if new_bs is not None:
-                # each shard normalized by ITS batch slice's statistics
-                # (torch DataParallel BatchNorm semantics, what the
-                # reference trains with); averaging the advanced running
-                # stats keeps the replicated train state bit-identical
-                # across shards
+                # shard_map path: each shard normalized by ITS batch
+                # slice's statistics (torch DataParallel BatchNorm
+                # semantics, what the reference trains with); averaging
+                # the advanced running stats keeps the replicated train
+                # state bit-identical across shards. NOTE the OTHER
+                # multi-device path (build_update_step's jit+mesh, no
+                # axis_name) lets GSPMD reduce the batch statistics over
+                # the GLOBAL sharded batch — sync-BN semantics. Both are
+                # faithful BatchNorm; they differ in stat granularity
+                # (documented in PARITY.md).
                 new_bs = jax.lax.pmean(new_bs, axis_name)
         updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)

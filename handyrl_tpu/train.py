@@ -605,6 +605,12 @@ class Learner:
             with open(self.model_path(self.model_epoch), 'rb') as f:
                 self.wrapper.load_params_bytes(f.read(), self._example_obs)
             self._resume = True
+        elif args.get('init_params'):
+            # warm start: params only — epoch counter, optimizer moments and
+            # lr EMA start fresh (unlike restart_epoch, which resumes all)
+            with open(args['init_params'], 'rb') as f:
+                self.wrapper.load_params_bytes(f.read(), self._example_obs)
+            print('warm-started params from %s' % args['init_params'])
 
         # generation accounting
         self.generation_results: Dict[int, tuple] = {}

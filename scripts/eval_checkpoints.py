@@ -7,7 +7,12 @@ matches on the accelerator, a few hundred games per point in seconds.
 
 Usage:
   python scripts/eval_checkpoints.py MODEL_DIR ENV OUT.jsonl \
-      [--every N] [--games G] [--envs E] [--opponent random|rulebase|CKPT]
+      [--every N] [--games G] [--envs E] [--opponent random|rulebase|CKPT] \
+      [--env-args JSON]
+
+--env-args merges extra env_args (e.g. '{"norm_kind": "batch"}') so the
+rebuilt net matches the checkpoints' param tree — REQUIRED when scoring a
+run trained with a non-default model config.
 
 Writes one JSON line per checkpoint: {"epoch": N, "opponent": O,
 "games": G, "win_rate": W, "mean": M} where win_rate = (mean outcome+1)/2
@@ -34,6 +39,8 @@ def main():
     n_envs = opt('--envs', 64)
     opponent = (opts[opts.index('--opponent') + 1]
                 if '--opponent' in opts else 'random')
+    extra_env_args = (json.loads(opts[opts.index('--env-args') + 1])
+                      if '--env-args' in opts else {})
 
     # honor an explicit operator platform choice under the axon site hook
     plat = os.environ.get('JAX_PLATFORMS', '').strip()
@@ -49,7 +56,7 @@ def main():
     from handyrl_tpu.environment import make_env, make_jax_env
     from handyrl_tpu.model import ModelWrapper
 
-    env_args = {'env': env_name}
+    env_args = {'env': env_name, **extra_env_args}
     env = make_env(env_args)
     env.reset()
     env_mod = make_jax_env(env_args)

@@ -15,8 +15,16 @@ Env: HungryGeese — the long-episode env (1..200 plies, hunger-truncated),
 where the two weightings actually differ.
 
 Run: JAX_PLATFORMS=cpu python scripts/replay_weighting_ab.py
-     [--epochs N] [--arms 1,4]
+     [--epochs N] [--arms 1,4] [--init CKPT]
 Appends one JSON row per arm to benchmarks.jsonl.
+
+--init (VERDICT r4 #5 — the divergent regime): warm-start both arms from
+a late-stage checkpoint (e.g. models_north_star_device/latest.ckpt) whose
+policy plays LONG episodes, so min(len//fs, W) actually spreads and the
+two weightings differ. Requires the full GeeseNet architecture (the
+checkpoint's); the windows/episode ratio in each row is the regime gate —
+rows where both arms sit near 1.0 are outside the divergent regime and
+say nothing about weighting.
 """
 
 import json
@@ -28,7 +36,7 @@ os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
 
 
-def run_arm(windows_cap: int, epochs: int):
+def run_arm(windows_cap: int, epochs: int, init_ckpt: str = ''):
     import jax
     if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':
         jax.config.update('jax_platforms', 'cpu')
@@ -51,12 +59,16 @@ def run_arm(windows_cap: int, epochs: int):
             # rulebase discriminates long after vs-random saturates
             'eval': {'opponent': ['random', 'rulebase']},
             'model_dir': 'models_ab_w%d' % windows_cap,
+            'init_params': init_ckpt,
         },
     }
     args = apply_defaults(raw)
     t0 = time.time()
-    learner = Learner(args=args,
-                      net=build('GeeseNet', layers=4, filters=16))
+    # --init checkpoints are full-GeeseNet snapshots; the from-scratch A/B
+    # keeps the small net for CPU-budget reasons
+    net = build('GeeseNet') if init_ckpt else build('GeeseNet', layers=4,
+                                                    filters=16)
+    learner = Learner(args=args, net=net)
     learner.run()
     wall = time.time() - t0
 
@@ -71,8 +83,12 @@ def run_arm(windows_cap: int, epochs: int):
              for opp, (n0, r0) in per_opp.items()}
     games = {opp: n0 for opp, (n0, _) in per_opp.items()}
     stats = learner.trainer.replay_stats
+    eps = max(1, learner.num_returned_episodes)
     return {
         'row': 'replay-weighting-ab',
+        'init_ckpt': init_ckpt or None,
+        'windows_per_episode_ratio': round(
+            (stats.get('windows_ingested') or 0) / eps, 2),
         'windows_per_episode': windows_cap,
         'weighting': 'per-episode (reference)' if windows_cap == 1
                      else 'per-window (x%d cap)' % windows_cap,
@@ -88,11 +104,11 @@ def run_arm(windows_cap: int, epochs: int):
 
 
 def main():
-    epochs, arms = 12, [1, 4]
+    epochs, arms, init_ckpt = 12, [1, 4], ''
     argv = iter(sys.argv[1:])
     for a in argv:
         key, _, val = a.partition('=')
-        if key in ('--epochs', '--arms') and not val:
+        if key in ('--epochs', '--arms', '--init') and not val:
             try:
                 val = next(argv)
             except StopIteration:
@@ -101,11 +117,13 @@ def main():
             epochs = int(val)
         elif key == '--arms':
             arms = [int(x) for x in val.split(',')]
+        elif key == '--init':
+            init_ckpt = val
         else:
             raise SystemExit('unknown argument %r' % a)
     out = os.path.join(os.path.dirname(__file__), '..', 'benchmarks.jsonl')
     for w in arms:
-        row = run_arm(w, epochs)
+        row = run_arm(w, epochs, init_ckpt)
         print(json.dumps(row), flush=True)
         with open(os.path.abspath(out), 'a') as f:
             f.write(json.dumps(row) + '\n')

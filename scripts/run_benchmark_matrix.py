@@ -88,22 +88,6 @@ ROWS = {
                        'device_chunk_steps': 32, 'eval_envs': 32,
                        'sgd_steps_per_chunk': 4},
     },
-    # Round-5 A/B arm: geister-fused with FULL BatchNorm parity
-    # (norm_kind='batch' = flax nn.BatchNorm, batch statistics in the
-    # training forward + running averages served to actors/evaluators —
-    # the reference's nn.BatchNorm2d train/eval split, geister.py:107,122
-    # + model.py:54). Baseline arm = 'geister-fused' (GroupNorm, 0.466 at
-    # 1,243 episodes r4); torch reference bar = 0.661 at ~1k.
-    'geister-fused-bn': {
-        'env_args': {'env': 'Geister', 'norm_kind': 'batch'},
-        'train_args': {'batch_size': 32, 'forward_steps': 16,
-                       'burn_in_steps': 4, 'update_episodes': 100,
-                       'minimum_episodes': 200, 'generation_envs': 32,
-                       'observation': True,
-                       'device_generation': True, 'device_replay': True,
-                       'device_chunk_steps': 32, 'eval_envs': 32,
-                       'sgd_steps_per_chunk': 4},
-    },
     'geese': {
         'env_args': {'env': 'HungryGeese'},
         'train_args': {'batch_size': 64, 'forward_steps': 16,
@@ -112,23 +96,6 @@ ROWS = {
                        'turn_based_training': False, 'observation': True,
                        'gamma': 0.99,
                        'policy_target': 'VTRACE', 'value_target': 'VTRACE'},
-    },
-    # Round-5 A/B arm: GeeseNet with full BatchNorm in the stem + all 12
-    # torus blocks (the reference TorusConv2d placement). Baseline arm =
-    # 'geese-device' (GroupNorm). The round-4 Geister forensics convicted
-    # the GroupNorm substitution there; this measures whether the flagship
-    # net is depressed by the same cause (VERDICT r4 #2).
-    'geese-device-bn': {
-        'env_args': {'env': 'HungryGeese', 'norm_kind': 'batch'},
-        'train_args': {'batch_size': 64, 'forward_steps': 16,
-                       'update_episodes': 100, 'minimum_episodes': 200,
-                       'generation_envs': 64,
-                       'turn_based_training': False, 'observation': True,
-                       'gamma': 0.99,
-                       'policy_target': 'VTRACE', 'value_target': 'VTRACE',
-                       'device_generation': True, 'device_replay': True,
-                       'device_chunk_steps': 32, 'eval_envs': 32,
-                       'sgd_steps_per_chunk': 64},
     },
     # VERDICT r1 #5: the fully device-resident Hungry Geese pipeline —
     # rollouts, replay ring, and SGD all on the accelerator
@@ -148,6 +115,19 @@ ROWS = {
                        'sgd_steps_per_chunk': 64},
     },
 }
+
+# Round-5 norm A/B arms: DERIVED from their baseline rows so the pair can
+# only ever differ in the one knob under test (norm_kind='batch' = full
+# reference BatchNorm parity — batch statistics in the training forward,
+# running averages served at inference; reference geister.py:107,122,
+# hungry_geese.py:23-44, model.py:54). Baselines: 'geister-fused'
+# (GroupNorm, 0.466 at 1,243 episodes r4; torch reference bar 0.661 at
+# ~1k) and 'geese-device' (GroupNorm).
+for _base, _twin in (('geister-fused', 'geister-fused-bn'),
+                     ('geese-device', 'geese-device-bn')):
+    _row = json.loads(json.dumps(ROWS[_base]))
+    _row['env_args']['norm_kind'] = 'batch'
+    ROWS[_twin] = _row
 
 
 def run_row(name, epochs):

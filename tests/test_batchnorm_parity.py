@@ -128,8 +128,11 @@ def test_update_step_advances_batch_stats_ema_only(geister_batch_and_wrapper):
     train_leaves = len(jax.tree_util.tree_leaves(trainable))
     all_leaves = len(jax.tree_util.tree_leaves(state.params))
     assert all_leaves > train_leaves, 'batch_stats leaves exist'
-    # clip(=1 scalar-free) + weight decay(0) + adam(mu,nu per leaf) + count
-    assert opt_leaves < 2 * all_leaves + 2, 'optimizer must not cover batch_stats'
+    # clip + weight-decay carry no state; adam = (mu, nu) per TRAINABLE
+    # leaf + 1 count scalar. Equality pins Adam to exactly the trainable
+    # set — covering batch_stats too would give 2*all_leaves + 1
+    assert opt_leaves == 2 * train_leaves + 1, \
+        'optimizer must cover exactly the trainable collections'
 
     update = build_update_step(wrapper.module, LossConfig.from_args(args),
                                mesh=None, donate=False)
