@@ -220,9 +220,8 @@ def headline_setup(B=128, T=16, dtype=None, seed=0):
 
 def run_bench(probe: dict):
     import jax
-    plat = os.environ.get('JAX_PLATFORMS')
-    if plat:
-        jax.config.update('jax_platforms', plat)
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
     import jax.numpy as jnp
 
     from handyrl_tpu.ops.train_step import build_update_step

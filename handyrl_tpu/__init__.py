@@ -62,3 +62,19 @@ def setup_compile_cache():
         jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.5)
     except Exception:  # pragma: no cover - cache is best-effort
         pass
+
+
+def honor_platform_env():
+    """Re-assert an explicit JAX_PLATFORMS choice over the axon site hook.
+
+    The axon TPU plugin's site registration overrides jax_platforms at
+    import time, so the env var alone does not stick in this image; every
+    entry point that wants to honor an operator's JAX_PLATFORMS=cpu (tests,
+    benches, measurement scripts) calls this instead of hand-rolling the
+    re-assert. 'axon' itself (or unset) is left to the site default.
+    """
+    import os
+    plat = os.environ.get('JAX_PLATFORMS', '').strip()
+    if plat and plat != 'axon':
+        import jax
+        jax.config.update('jax_platforms', plat)

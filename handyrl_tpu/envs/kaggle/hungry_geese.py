@@ -58,8 +58,8 @@ class Environment(BaseEnvironment):
 
     def __init__(self, args: Optional[dict] = None):
         super().__init__(args)
-        args = args or {}
-        self.rng = random.Random(args.get('id', 0))
+        self.args = args or {}
+        self.rng = random.Random(self.args.get('id', 0))
         self.reset()
 
     def reset(self, args: Optional[dict] = None):

@@ -42,15 +42,10 @@ def main():
     extra_env_args = (json.loads(opts[opts.index('--env-args') + 1])
                       if '--env-args' in opts else {})
 
-    # honor an explicit operator platform choice under the axon site hook
-    plat = os.environ.get('JAX_PLATFORMS', '').strip()
-    if plat and plat != 'axon':
-        import jax
-        jax.config.update('jax_platforms', plat)
-
     import numpy as np
 
     import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
     handyrl_tpu.setup_compile_cache()
     from handyrl_tpu.device_generation import DeviceEvaluator
     from handyrl_tpu.environment import make_env, make_jax_env

@@ -16,6 +16,10 @@ artifacts the verdict asked for, ON the accelerator:
 
 Run (needs the TPU): python scripts/hbm_experiments.py [--steps 30]
 Appends rows to benchmarks.jsonl and prints the table.
+
+--B/--T shrink the geometry for an off-chip plumbing dry-run
+(JAX_PLATFORMS=cpu ... --B 8 --T 4 --steps 2); rows from non-default
+geometry are tagged 'dryrun' and are NOT roofline evidence.
 """
 
 import json
@@ -79,12 +83,20 @@ def per_op_table(compiled, top=25):
     return rows[:top], sum(r['bytes'] for r in rows)
 
 
-def variant(name, dtype=None, cast_state=False, B=128, T=16, steps=30):
+# the roofline geometry (bench.py headline); rows at any other geometry
+# are plumbing dry-runs, tagged so they can never read as roofline evidence
+HEADLINE_B, HEADLINE_T = 128, 16
+
+
+def variant(name, dtype=None, cast_state=False, B=HEADLINE_B, T=HEADLINE_T,
+            steps=30):
     import jax
     import jax.numpy as jnp
     from bench import headline_setup, time_compiled_step
     from handyrl_tpu.ops.train_step import build_update_step
 
+    tagged = (name if (B, T) == (HEADLINE_B, HEADLINE_T)
+              else '%s-dryrun-B%d-T%d' % (name, B, T))
     module, cfg, batch, state = headline_setup(
         B, T, dtype=jnp.bfloat16 if dtype == 'bf16' else None)
     if cast_state:
@@ -96,7 +108,7 @@ def variant(name, dtype=None, cast_state=False, B=128, T=16, steps=30):
     step = build_update_step(module, cfg, donate=False)
     lr = jnp.asarray(1e-5, jnp.float32)
     sec, flops, hbm = time_compiled_step(step, state, batch, lr, steps)
-    row = {'row': 'hbm-experiment', 'variant': name,
+    row = {'row': 'hbm-experiment', 'variant': tagged,
            'step_ms': round(sec * 1e3, 2),
            'traj_per_sec': round(B / sec, 1),
            'flops_per_step': flops, 'hbm_bytes_per_step': hbm,
@@ -108,8 +120,8 @@ def variant(name, dtype=None, cast_state=False, B=128, T=16, steps=30):
         row['top_ops'] = [{k: r[k] for k in ('op', 'bytes')}
                           for r in table[:8]]
         row['sum_table_bytes'] = total
-        if name == 'bf16-act':
-            print('--- per-op traffic, %s (top 25) ---' % name)
+        if name == 'bf16-act':   # base name: the print path runs in dry-runs too
+            print('--- per-op traffic, %s (top 25) ---' % tagged)
             for r in table:
                 print('%12d  %-18s %s' % (r['bytes'], r['op'], r['name']))
     except Exception as exc:  # noqa: BLE001
@@ -118,20 +130,26 @@ def variant(name, dtype=None, cast_state=False, B=128, T=16, steps=30):
 
 
 def main():
-    steps = 30
+    steps, B, T = 30, 128, 16
     argv = iter(sys.argv[1:])
     for a in argv:
         key, _, val = a.partition('=')
         if key == '--steps':
             steps = int(val or next(argv))
+        elif key == '--B':
+            B = int(val or next(argv))
+        elif key == '--T':
+            T = int(val or next(argv))
         else:
             raise SystemExit('unknown argument %r' % a)
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
     out = os.path.join(os.path.dirname(__file__), '..', 'benchmarks.jsonl')
     for name, kw in (('fp32', {}),
                      ('bf16-act', {'dtype': 'bf16'}),
                      ('bf16-act+state', {'dtype': 'bf16',
                                          'cast_state': True})):
-        row = variant(name, steps=steps, **kw)
+        row = variant(name, steps=steps, B=B, T=T, **kw)
         print(json.dumps(row), flush=True)
         with open(os.path.abspath(out), 'a') as f:
             f.write(json.dumps(row) + '\n')

@@ -131,11 +131,8 @@ for _base, _twin in (('geister-fused', 'geister-fused-bn'),
 
 
 def run_row(name, epochs):
-    # honor an explicit operator platform choice under the axon site hook
-    plat = os.environ.get('JAX_PLATFORMS', '').strip()
-    if plat and plat != 'axon':
-        import jax
-        jax.config.update('jax_platforms', plat)
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
     from handyrl_tpu.config import apply_defaults
     from handyrl_tpu.train import Learner
 

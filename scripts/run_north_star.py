@@ -58,13 +58,8 @@ def latest_epoch(model_dir: str) -> int:
 
 
 def main():
-    # honor an explicit operator platform choice (JAX_PLATFORMS=cpu for the
-    # host-dynamics control): the axon site hook overrides the env var at
-    # import time, so re-assert it via jax.config like main.py does
-    plat = os.environ.get('JAX_PLATFORMS', '').strip()
-    if plat:
-        import jax
-        jax.config.update('jax_platforms', plat)
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
     from handyrl_tpu.config import apply_defaults
     from handyrl_tpu.train import Learner
 

@@ -200,3 +200,12 @@ def test_snapshot_roundtrip_carries_batch_stats(geister_batch_and_wrapper):
     out_src = src.inference(env.observation(0), src.init_hidden())
     out_dst = dst.inference(env.observation(0), dst.init_hidden())
     np.testing.assert_allclose(out_src['policy'], out_dst['policy'], atol=1e-6)
+
+
+def test_norm_kind_env_args_plumbing_geese():
+    """env_args {'norm_kind': 'batch'} reaches GeeseNet (caught live:
+    the geese env didn't store self.args)."""
+    from handyrl_tpu.environment import make_env
+    env = make_env({'env': 'HungryGeese', 'norm_kind': 'batch'})
+    assert env.net().norm_kind == 'batch'
+    assert make_env({'env': 'HungryGeese'}).net().norm_kind == 'group'
