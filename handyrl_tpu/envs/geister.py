@@ -347,7 +347,8 @@ class Environment(BaseEnvironment):
         # env_args: {'norm_kind': 'batch'} surfaces the round-4 norm
         # investigation knob (BENCHMARKS.md Geister quality-gap section)
         # without a source edit
-        return GeisterNet(norm_kind=self.args.get('norm_kind', 'group'))
+        return GeisterNet(norm_kind=self.args.get('norm_kind', 'group'),
+                          policy_head=self.args.get('policy_head', 'dense'))
 
     def __str__(self) -> str:
         def glyph(piece):

@@ -104,6 +104,8 @@ def make_norm(kind: str, filters: int, dtype, train: bool = False) -> nn.Module:
         return nn.LayerNorm(dtype=dtype)
     if kind == 'group':
         return nn.GroupNorm(num_groups=min(8, filters), dtype=dtype)
+    if kind == 'group1':   # the heads' single-group flavor
+        return nn.GroupNorm(num_groups=1, dtype=dtype)
     # never fall back silently: a typo'd kind reinstating GroupNorm would
     # quietly reintroduce the exact regression 'batch' exists to fix
     raise ValueError('unknown norm kind %r' % (kind,))
@@ -150,6 +152,31 @@ class TorusConv(nn.Module):
         return x
 
 
+class SpatialPolicyHead(nn.Module):
+    """Per-cell policy logits with the reference Conv2dHead's structure
+    (reference geister.py:100-113): 3x3 conv (no bias) + norm + relu, then
+    a 1x1 conv emitting ``out_filters`` logits PER CELL, flattened
+    channel-major so logit index = f*H*W + x*W + y — the '4 x 36' move
+    encoding. The spatial parameterization is the head's point: each
+    cell's logits come from its own 3x3 neighborhood (a strong inductive
+    bias for per-piece directional moves) instead of a global dense map.
+    """
+    filters: int
+    out_filters: int
+    norm_kind: str = 'group1'
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(self.filters, (3, 3), padding='SAME', use_bias=False,
+                    dtype=self.dtype)(x)
+        h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype)(h)
+        h = jnp.moveaxis(h, -1, -3)            # (..., F, H, W)
+        return h.reshape(*h.shape[:-3], -1)
+
+
 class PolicyHead(nn.Module):
     """1x1 conv squeeze -> leaky-relu -> dense logits (no bias)."""
     out_filters: int
@@ -174,10 +201,7 @@ class ScalarHead(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        if self.norm_kind == 'group1':
-            h = nn.GroupNorm(num_groups=1, dtype=self.dtype)(h)
-        else:
-            h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
+        h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
         h = nn.relu(h)
         h = h.reshape(*h.shape[:-3], -1)
         return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from . import register
-from .blocks import ConvBlock, DRC, PolicyHead, ScalarHead, to_nhwc
+from .blocks import (ConvBlock, DRC, PolicyHead, ScalarHead,
+                     SpatialPolicyHead, to_nhwc)
 
 
 @register('GeisterNet')
@@ -30,6 +31,15 @@ class GeisterNet(nn.Module):
     # it measured tied with GroupNorm (0.452 vs 0.466 at ~1k episodes,
     # BENCHMARKS.md). Default follows the measured verdict in BENCHMARKS.md.
     norm_kind: str = 'group'
+    # 'dense' = the measured r1-r4 baseline head (1x1 conv -> Dense over
+    # the flattened map); 'spatial' = the reference Conv2dHead structure
+    # (3x3 conv + norm + relu -> 1x1 conv, 4 logits PER CELL — reference
+    # geister.py:100-113,144). The round-5 rescores measured BOTH norm
+    # arms flat at ~0.45 vs the reference's 0.661 while its policy stays
+    # near-uniform — the spatially-local head is the next suspect: per-
+    # cell logits see their own 3x3 neighborhood instead of learning a
+    # global 288->144 dense map. Default follows BENCHMARKS.md verdicts.
+    policy_head: str = 'dense'
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
@@ -61,7 +71,11 @@ class GeisterNet(nn.Module):
             hidden = self.init_hidden(h.shape[:-3])
         h, next_hidden = body(h, hidden)
 
-        p_move = PolicyHead(8, 4 * 36, dtype=self.dtype)(h)
+        if self.policy_head == 'spatial':
+            p_move = SpatialPolicyHead(8, 4, norm_kind=head_norm,
+                                       dtype=self.dtype)(h, train)
+        else:
+            p_move = PolicyHead(8, 4 * 36, dtype=self.dtype)(h)
         # setup-phase logits conditioned only on the side-to-move bit
         turn_color = scalar[..., :1]
         p_set = nn.Dense(70, dtype=self.dtype)(turn_color)

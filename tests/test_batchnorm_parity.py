@@ -209,3 +209,72 @@ def test_norm_kind_env_args_plumbing_geese():
     env = make_env({'env': 'HungryGeese', 'norm_kind': 'batch'})
     assert env.net().norm_kind == 'batch'
     assert make_env({'env': 'HungryGeese'}).net().norm_kind == 'group'
+
+
+def test_spatial_policy_head_layout_and_plumbing():
+    """SpatialPolicyHead flattens channel-major: logit index =
+    direction*36 + x*6 + y, the env's move encoding
+    (envs/geister.py:114-118). Pinned by forcing the final 1x1 conv to
+    emit direction-constant maps and checking where they land."""
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.models.blocks import SpatialPolicyHead
+
+    head = SpatialPolicyHead(4, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 6, 8),
+                    jnp.float32)
+    variables = head.init(jax.random.PRNGKey(0), x)
+    out = head.apply(variables, x)
+    assert out.shape == (2, 144)
+
+    # zero the final conv kernel, set bias[f] = f: every cell of
+    # direction-plane f must read f after flattening
+    params = jax.tree_util.tree_map(np.array, variables['params'])
+    last = sorted(k for k in params if k.startswith('Conv'))[-1]
+    params[last]['kernel'] = np.zeros_like(params[last]['kernel'])
+    params[last]['bias'] = np.arange(4, dtype=np.float32)
+    out = np.asarray(head.apply({'params': params}, x))
+    for d in range(4):
+        for cell in (0, 7, 35):
+            assert out[0, d * 36 + cell] == d
+
+    # env_args plumbing + the A/B config (spatial head + full BatchNorm)
+    # constructs, serves B=1 inference, and takes a training step
+    env = make_env({'env': 'Geister', 'policy_head': 'spatial',
+                    'norm_kind': 'batch'})
+    assert env.net().policy_head == 'spatial'
+    assert make_env({'env': 'Geister'}).net().policy_head == 'dense'
+    from handyrl_tpu.model import ModelWrapper
+    env.reset()
+    w = ModelWrapper(env.net())
+    out = w.inference(env.observation(0), w.init_hidden())
+    assert out['policy'].shape == (214,)
+    assert np.all(np.isfinite(out['policy']))
+    assert 'batch_stats' in w.params
+
+
+def test_spatial_batch_head_trains(geister_batch_and_wrapper):
+    """One compiled update step on the exact round-5 A/B model config
+    (policy_head='spatial', norm_kind='batch'): finite loss, advancing
+    running stats — so the combination cannot first fail mid-benchmark."""
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
+
+    _, batch, args = geister_batch_and_wrapper
+    wrapper = ModelWrapper(GeisterNet(filters=8, drc_layers=2,
+                                      drc_repeats=1, norm_kind='batch',
+                                      policy_head='spatial'))
+    from handyrl_tpu.environment import make_env
+    env = make_env({'env': 'Geister'})
+    env.reset()
+    wrapper.ensure_params(env.observation(0))
+    state = init_train_state(jax.tree_util.tree_map(jnp.array, wrapper.params))
+    update = build_update_step(wrapper.module, LossConfig.from_args(args),
+                               mesh=None, donate=False)
+    before = jax.tree_util.tree_map(np.array, state.params['batch_stats'])
+    state2, metrics = update(state, batch, jnp.float32(1e-3))
+    assert np.isfinite(float(metrics['total']))
+    moved = [float(np.max(np.abs(_np(a) - b)))
+             for a, b in zip(jax.tree_util.tree_leaves(
+                 state2.params['batch_stats']),
+                 jax.tree_util.tree_leaves(before))]
+    assert max(moved) > 1e-7
