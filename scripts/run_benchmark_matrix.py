@@ -129,6 +129,16 @@ for _base, _twin in (('geister-fused', 'geister-fused-bn'),
     _row['env_args']['norm_kind'] = 'batch'
     ROWS[_twin] = _row
 
+# geister arms for the round-5 spatial-policy-head hypothesis: 'sp' =
+# reference head structure alone, 'sp-bn' = head + full BatchNorm (the
+# most reference-faithful GeisterNet this repo can express).
+for _twin, _extra in (('geister-fused-sp', {'policy_head': 'spatial'}),
+                      ('geister-fused-sp-bn', {'policy_head': 'spatial',
+                                               'norm_kind': 'batch'})):
+    _row = json.loads(json.dumps(ROWS['geister-fused']))
+    _row['env_args'].update(_extra)
+    ROWS[_twin] = _row
+
 
 def run_row(name, epochs):
     import handyrl_tpu
