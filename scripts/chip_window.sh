@@ -1,0 +1,97 @@
+#!/bin/bash
+# Round-5 chip-window queue: run the TPU-gated measurements in priority
+# order against a live tunnel (BENCHMARKS.md "Round-5 continuity note").
+# Designed to be chained off the probe loop:
+#   bash scripts/tpu_probe_loop.sh /tmp/tpu_probe.log 300 && \
+#   bash scripts/chip_window.sh
+#
+# Discipline (round-1 lesson): never SIGKILL a chip client — an axon
+# client killed -9 leaves the exclusive tunnel grant unreleased and
+# wedges the backend for everyone after. `timeout` here sends SIGINT
+# only (no --kill-after): Python maps SIGINT to KeyboardInterrupt, so
+# every queue script unwinds through its finally blocks and the axon
+# client releases the grant (bench.py additionally installs its own
+# INT/TERM handlers and emits its JSON line first). A process stuck
+# inside a single wedged device dispatch won't see the signal until the
+# call returns — if an item overstays its budget by a lot, inspect
+# $LOG_DIR/queue.log before doing anything by hand, and never kill -9.
+#
+# Env knobs: LOG_DIR (default /tmp/chip_window), NS_BUDGET_S (north-star
+# training budget, default 10800 = 3h).
+set -u
+cd "$(dirname "$0")/.."
+# An inherited JAX_PLATFORMS=cpu (the documented de-risk setting) would
+# silently run the whole chip-gated queue on CPU: export it EMPTY so the
+# site default (axon TPU) wins everywhere — empty-but-set also defeats
+# the cpu setdefault in geese_norm_ab.py / replay_weighting_ab.py.
+export JAX_PLATFORMS=
+# per-window log dir: re-runs (one per tunnel window) must not truncate
+# the previous window's diagnostics
+LOG_DIR=${LOG_DIR:-/tmp/chip_window/$(date +%m%d_%H%M%S)}
+NS_BUDGET_S=${NS_BUDGET_S:-10800}
+mkdir -p "$LOG_DIR"
+
+note() { echo "$(date +%H:%M:%S) $*" >> "$LOG_DIR/queue.log"; }
+
+run_item() {  # run_item NAME BUDGET_S CMD...
+  local name=$1 budget=$2; shift 2
+  note "START $name (budget ${budget}s): $*"
+  timeout --signal=INT "$budget" "$@" > "$LOG_DIR/$name.log" 2>&1
+  note "END   $name rc=$?"
+}
+
+note "=== chip window opened ==="
+
+# 1. headline number (its own SIGALRM deadline is the real bound)
+BENCH_DEADLINE_SEC=900 run_item bench 960 python bench.py
+
+# 2. GeeseNet norm A/B (VERDICT r4 #2 — the highest-leverage unknown).
+#    JAX_PLATFORMS= (empty) so the script's cpu setdefault does not fire
+#    and the site default (axon TPU) wins.
+JAX_PLATFORMS= run_item geese_norm_ab 5400 \
+  python scripts/geese_norm_ab.py --epochs 10
+
+# 3. roofline per-op table + bf16-state variants (VERDICT r3 #4 / r4 weak #3)
+run_item hbm_experiments 1800 python scripts/hbm_experiments.py
+
+# 4. league-eval dispatch economics on the tunnel (VERDICT r4 #7)
+run_item geister_league_eval 900 \
+  python scripts/geister_league_eval.py --budget-s 120
+
+# 5. north-star fresh run (checkpoints lost to the re-provision; starts
+#    at epoch 0 and re-earns the curve at chip speed). All outputs go to
+#    _r5 files: the committed north_star_device*.jsonl hold the LOST
+#    run's epochs, and a fresh epoch-0 run appended there would
+#    interleave two incomparable runs under the same epoch keys.
+run_item north_star $((NS_BUDGET_S + 600)) \
+  python scripts/run_north_star.py --budget-s "$NS_BUDGET_S" \
+    --metrics-out north_star_device_r5.jsonl
+
+# 6. 1k-game rescore of the fresh north-star checkpoints, vs random AND
+#    rulebase (VERDICT r4 #4: >=1k games/point)
+if [ -d models_north_star_device ]; then
+  run_item ns_rescore_random 3600 \
+    python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
+      north_star_device_curve_r5.jsonl --every 5 --games 1000 --skip-scored
+  run_item ns_rescore_rulebase 3600 \
+    python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
+      north_star_device_curve_rulebase_r5.jsonl --every 5 --games 1000 \
+      --opponent rulebase --skip-scored
+fi
+
+# 7. geister arms at chip speed, 30 epochs (the spatial-head/norm matrix)
+run_item geister_arms 7200 \
+  python scripts/run_benchmark_matrix.py geister-fused geister-fused-sp-bn \
+    --epochs=30
+
+# 8. divergent-regime replay A/B, warm-started from the freshest
+#    north-star checkpoint (VERDICT r4 #5). latest.ckpt is rewritten on
+#    every checkpoint interval, so it is by definition the newest params
+#    file (numbered globs would also match trainer_state.ckpt).
+if [ -f models_north_star_device/latest.ckpt ]; then
+  JAX_PLATFORMS= run_item replay_ab 3600 \
+    python scripts/replay_weighting_ab.py --epochs 12 \
+      --init models_north_star_device/latest.ckpt
+fi
+
+note "=== queue drained ==="

@@ -8,7 +8,13 @@ matches on the accelerator, a few hundred games per point in seconds.
 Usage:
   python scripts/eval_checkpoints.py MODEL_DIR ENV OUT.jsonl \
       [--every N] [--games G] [--envs E] [--opponent random|rulebase|CKPT] \
-      [--env-args JSON]
+      [--env-args JSON] [--skip-scored]
+
+--skip-scored makes reruns incremental: epochs already present in
+OUT.jsonl (for the same opponent) are not re-scored, so a recurring
+caller (scripts/chip_window.sh per tunnel window) only pays for
+checkpoints that appeared since the last pass instead of re-evaluating
+the whole curve and appending duplicate rows.
 
 --env-args merges extra env_args (e.g. '{"norm_kind": "batch"}') so the
 rebuilt net matches the checkpoints' param tree — REQUIRED when scoring a
@@ -64,6 +70,19 @@ def main():
     picks = [e for i, e in enumerate(ckpts) if i % every == 0]
     if ckpts and ckpts[-1] not in picks:
         picks.append(ckpts[-1])
+    if '--skip-scored' in opts and os.path.exists(out_path):
+        scored = set()
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get('opponent') == opponent and 'epoch' in row:
+                    scored.add(row['epoch'])
+        picks = [e for e in picks if e not in scored]
+        print('skip-scored: %d epochs already in %s'
+              % (len(scored), out_path), flush=True)
     print('evaluating %d checkpoints of %d (every %d) from %s'
           % (len(picks), len(ckpts), every, model_dir), flush=True)
 

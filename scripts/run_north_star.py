@@ -18,7 +18,15 @@ from the newest one (params + optimizer state), so the curve continues
 across interrupted windows.
 
 Usage:
-  python scripts/run_north_star.py [--epochs N] [--host] [--budget-s S]
+  python scripts/run_north_star.py [--epochs N] [--host] [--budget-s S] \
+      [--metrics-out PATH]
+
+--metrics-out redirects the per-epoch metrics JSONL (default
+north_star_<tag>.jsonl). Use it when the model dir starts EMPTY but the
+default file already holds a previous run's epochs (the round-5 case:
+checkpoints were lost to a re-provision, so a fresh run restarts at
+epoch 0 — appending to the old file would interleave two incomparable
+runs under the same epoch keys).
 """
 
 import json
@@ -66,6 +74,7 @@ def main():
     epochs = 600
     host = False
     budget_s = None
+    metrics_out = None
     argv = sys.argv[1:]
     while argv:
         a = argv.pop(0)
@@ -75,6 +84,8 @@ def main():
             host = True
         elif a == '--budget-s':
             budget_s = float(argv.pop(0))
+        elif a == '--metrics-out':
+            metrics_out = argv.pop(0)
         else:
             raise SystemExit('unknown arg: %s' % a)
 
@@ -91,7 +102,8 @@ def main():
         raw['train_args']['generation_envs'] = 16
     model_dir = 'models_north_star_%s' % tag
     raw['train_args']['model_dir'] = model_dir
-    raw['train_args']['metrics_jsonl'] = 'north_star_%s.jsonl' % tag
+    raw['train_args']['metrics_jsonl'] = (metrics_out or
+                                          'north_star_%s.jsonl' % tag)
     raw['train_args']['epochs'] = epochs
     start = latest_epoch(model_dir)
     raw['train_args']['restart_epoch'] = start
