@@ -99,7 +99,9 @@ def test_plot_parsers(tmp_path):
 
 def test_eval_checkpoints_script(trained_models, monkeypatch, tmp_path):
     """Offline checkpoint quality curve: one JSON row per checkpoint with a
-    win rate from whole-match device evaluation."""
+    win rate from whole-match device evaluation; --skip-scored makes a
+    rerun incremental (no duplicate {epoch, opponent} rows — the
+    chip_window.sh once-per-tunnel-window contract)."""
     import json
 
     import eval_checkpoints
@@ -114,3 +116,23 @@ def test_eval_checkpoints_script(trained_models, monkeypatch, tmp_path):
     for r in rows:
         assert r['games'] >= 12 and 0.0 <= r['win_rate'] <= 1.0
         assert r['opponent'] == 'random'
+
+    # rerun with --skip-scored: everything already scored -> no new rows
+    monkeypatch.setattr(sys, 'argv',
+                        ['eval_checkpoints.py', trained_models, 'TicTacToe',
+                         out, '--every', '1', '--games', '12',
+                         '--envs', '4', '--skip-scored'])
+    eval_checkpoints.main()
+    rows2 = [json.loads(l) for l in open(out)]
+    assert [r['epoch'] for r in rows2] == [1, 2], \
+        'skip-scored rerun must not append duplicate rows'
+
+    # drop epoch 2's row: a rerun must score exactly the unscored epoch
+    # (the incremental half of the contract — a skip-everything regression
+    # would leave the file short)
+    with open(out, 'w') as f:
+        f.write(json.dumps(rows2[0]) + '\n')
+    eval_checkpoints.main()
+    rows3 = [json.loads(l) for l in open(out)]
+    assert [r['epoch'] for r in rows3] == [1, 2], \
+        'skip-scored rerun must evaluate epochs missing from the file'
