@@ -282,7 +282,8 @@ class Environment(BaseEnvironment):
         # stem + all blocks (reference TorusConv2d's nn.BatchNorm2d,
         # hungry_geese.py:23-35,43-44) — the round-5 norm A/B knob
         from ...models.geese import GeeseNet
-        return GeeseNet(norm_kind=self.args.get('norm_kind', 'group'))
+        return GeeseNet(norm_kind=self.args.get('norm_kind', 'group'),
+                        torus_impl=self.args.get('torus_impl', 'pad'))
 
     def __str__(self) -> str:
         grid = [['.'] * C for _ in range(R)]

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -132,24 +133,100 @@ class TorusConv(nn.Module):
     """Conv with wrap-around (toroidal) padding, NHWC.
 
     TPU-native counterpart of the reference's TorusConv2d
-    (hungry_geese.py:23-35): the wrap is a jnp.pad(mode='wrap') that XLA
-    fuses with the convolution."""
+    (hungry_geese.py:23-35). Two mathematically identical implementations
+    (pinned against each other by tests/test_torus_halo.py):
+
+    * ``impl='pad'``: jnp.pad(mode='wrap') then a VALID conv. Simple, but
+      the wrap-pad materializes a padded copy of the full activation in
+      HBM for every block — the round-5 per-op table showed these
+      copies/slices as the largest single HBM consumers of the GeeseNet
+      update step (BENCHMARKS.md round-5 chip window).
+    * ``impl='halo'``: the conv runs with XLA window padding (zero-pad
+      folded into the conv HLO — no materialized pad), and the missing
+      wrapped contributions are added back exactly: kernel-row strips for
+      the top/bottom output rows, kernel-column strips for the left/right
+      output columns, and the four diagonal corner taps. All correction
+      operands are 1-row/1-col strips, so the full-tensor pad copy never
+      exists.
+
+    Both impls share the same param tree ('Conv_0' kernel/bias), so
+    checkpoints transfer and an A/B is config-only."""
     filters: int
     kernel: int = 3
     norm: bool = True
     norm_kind: str = 'group'
+    impl: str = 'pad'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         kh, kw = self.kernel // 2, self.kernel // 2
-        pad = [(0, 0)] * (x.ndim - 3) + [(kh, kh), (kw, kw), (0, 0)]
-        x = jnp.pad(x, pad, mode='wrap')
-        x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='VALID',
-                    use_bias=not self.norm, dtype=self.dtype)(x)
+        conv_padding = ('VALID' if self.impl == 'pad'
+                        else ((kh, kh), (kw, kw)))
+        conv = nn.Conv(self.filters, (self.kernel, self.kernel),
+                       padding=conv_padding, use_bias=not self.norm,
+                       dtype=self.dtype)
+        if self.impl == 'pad':
+            pad = [(0, 0)] * (x.ndim - 3) + [(kh, kh), (kw, kw), (0, 0)]
+            x = conv(jnp.pad(x, pad, mode='wrap'))
+        elif self.impl == 'halo':
+            if self.kernel != 3:
+                raise ValueError('halo impl is written for 3x3 kernels '
+                                 '(got %d)' % (self.kernel,))
+            x = _halo_correct(conv(x), x, conv, self.dtype)
+        else:
+            raise ValueError('unknown TorusConv impl %r' % (self.impl,))
         if self.norm:
             x = make_norm(self.norm_kind, self.filters, self.dtype, train)(x)
         return x
+
+
+def _halo_correct(y, x, conv: nn.Conv, dtype) -> jnp.ndarray:
+    """Add the wrapped-edge contributions a zero-padded 3x3 conv omitted.
+
+    y: conv(x) with window padding (1,1),(1,1); x: (..., H, W, C) NHWC.
+    Every omitted term has a source index out of range in rows, columns,
+    or both; the three classes are reinstated separately:
+
+      rows    output row 0 misses kernel-row-0 terms sourced from row H-1
+              (and symmetrically row H-1 / kernel row 2 / source row 0),
+              with IN-RANGE columns -> a 1-row conv, columns zero-padded;
+      cols    symmetric with kernel columns;
+      corners output (0,0) misses only the (di,dj)=(-1,-1) tap sourced at
+              (H-1, W-1) -> one C x F contraction per corner.
+    """
+    w = conv.variables['params']['kernel'].astype(dtype)   # (3, 3, C, F)
+    x = x.astype(dtype)
+    lead, (H, W, C) = x.shape[:-3], x.shape[-3:]
+    F = w.shape[-1]
+    x4 = x.reshape((-1, H, W, C))
+    dn = jax.lax.conv_dimension_numbers(
+        x4.shape, w.shape, ('NHWC', 'HWIO', 'NHWC'))
+
+    def strip_conv(src, kern, padding):
+        out = jax.lax.conv_general_dilated(
+            src, kern, (1, 1), padding, dimension_numbers=dn)
+        return out.reshape(lead + out.shape[1:])
+
+    # row wraps: single source row, single kernel row, columns zero-padded
+    top = strip_conv(x4[:, H - 1:H], w[0:1], ((0, 0), (1, 1)))  # (..,1,W,F)
+    bot = strip_conv(x4[:, 0:1], w[2:3], ((0, 0), (1, 1)))
+    # column wraps: single source column, single kernel column
+    left = strip_conv(x4[:, :, W - 1:], w[:, 0:1], ((1, 1), (0, 0)))
+    right = strip_conv(x4[:, :, 0:1], w[:, 2:3], ((1, 1), (0, 0)))
+
+    corner = lambda i, j, ki, kj: jnp.tensordot(
+        x[..., i, j, :], w[ki, kj], axes=1)               # (..., F)
+
+    y = y.at[..., 0, :, :].add(top[..., 0, :, :])
+    y = y.at[..., H - 1, :, :].add(bot[..., 0, :, :])
+    y = y.at[..., :, 0, :].add(left[..., :, 0, :])
+    y = y.at[..., :, W - 1, :].add(right[..., :, 0, :])
+    y = y.at[..., 0, 0, :].add(corner(H - 1, W - 1, 0, 0))
+    y = y.at[..., 0, W - 1, :].add(corner(H - 1, 0, 0, 2))
+    y = y.at[..., H - 1, 0, :].add(corner(0, W - 1, 2, 0))
+    y = y.at[..., H - 1, W - 1, :].add(corner(0, 0, 2, 2))
+    return y
 
 
 class SpatialPolicyHead(nn.Module):

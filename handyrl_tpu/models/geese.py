@@ -22,6 +22,7 @@ class GeeseNetLSTM(nn.Module):
     filters: int = 32
     stem_layers: int = 4
     norm_kind: str = 'group'
+    torus_impl: str = 'pad'
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
@@ -34,9 +35,10 @@ class GeeseNetLSTM(nn.Module):
     def __call__(self, obs, hidden, train: bool = False):
         x = to_nhwc(obs)
         h = nn.relu(TorusConv(self.filters, norm_kind=self.norm_kind,
-                              dtype=self.dtype)(x, train))
+                              impl=self.torus_impl, dtype=self.dtype)(x, train))
         for _ in range(self.stem_layers):
             h = nn.relu(h + TorusConv(self.filters, norm_kind=self.norm_kind,
+                                      impl=self.torus_impl,
                                       dtype=self.dtype)(h, train))
         if hidden is None:
             hidden = self.init_hidden(h.shape[:-3])
@@ -61,15 +63,20 @@ class GeeseNet(nn.Module):
     # in BENCHMARKS.md (the round-4 Geister forensics flipped the burden
     # of proof onto GroupNorm for this net too).
     norm_kind: str = 'group'
+    # 'halo' computes the identical torus conv without materializing the
+    # wrap-padded activation (blocks.TorusConv docstring / round-5 per-op
+    # HBM table); parity pinned by tests/test_torus_halo.py.
+    torus_impl: str = 'pad'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, obs, hidden=None, train: bool = False):
         x = to_nhwc(obs)                       # (..., 7, 11, 17)
         h = nn.relu(TorusConv(self.filters, norm_kind=self.norm_kind,
-                              dtype=self.dtype)(x, train))
+                              impl=self.torus_impl, dtype=self.dtype)(x, train))
         for _ in range(self.layers):
             h = nn.relu(h + TorusConv(self.filters, norm_kind=self.norm_kind,
+                                      impl=self.torus_impl,
                                       dtype=self.dtype)(h, train))
 
         # pool features at the acting goose's head cell (channel 0 of obs)
