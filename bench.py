@@ -185,7 +185,7 @@ def time_compiled_step(step_fn, state, batch, lr, steps, warmup=3,
     return (time.time() - t0) / steps, flops, hbm_bytes
 
 
-def headline_setup(B=128, T=16, dtype=None, seed=0):
+def headline_setup(B=128, T=16, dtype=None, seed=0, torus_impl=None):
     """Build the headline-config pieces: (module, cfg, batch, state).
 
     The ONE definition of what the headline benchmark measures — GeeseNet
@@ -194,6 +194,8 @@ def headline_setup(B=128, T=16, dtype=None, seed=0):
     measures the same program as the headline number it explains.
     ``dtype`` (e.g. jnp.bfloat16) clones the net with reduced-precision
     activations; params stay float32 (the learner's compute_dtype mode).
+    ``torus_impl`` ('pad'/'halo') selects the TorusConv implementation
+    (identical function, different HBM behavior — models/blocks.py).
     """
     import jax
     import numpy as np
@@ -208,6 +210,8 @@ def headline_setup(B=128, T=16, dtype=None, seed=0):
     module = build('GeeseNet')
     if dtype is not None:
         module = module.clone(dtype=dtype)
+    if torus_impl is not None:
+        module = module.clone(torus_impl=torus_impl)
     rng = np.random.RandomState(seed)
     batch = _synthetic_batch(B, T, 1, (17, 7, 11), 4, rng)
     params = module.init(jax.random.PRNGKey(0),

@@ -88,8 +88,8 @@ def per_op_table(compiled, top=25):
 HEADLINE_B, HEADLINE_T = 128, 16
 
 
-def variant(name, dtype=None, cast_state=False, B=HEADLINE_B, T=HEADLINE_T,
-            steps=30):
+def variant(name, dtype=None, cast_state=False, torus_impl=None,
+            B=HEADLINE_B, T=HEADLINE_T, steps=30):
     import jax
     import jax.numpy as jnp
     from bench import headline_setup, time_compiled_step
@@ -98,7 +98,8 @@ def variant(name, dtype=None, cast_state=False, B=HEADLINE_B, T=HEADLINE_T,
     tagged = (name if (B, T) == (HEADLINE_B, HEADLINE_T)
               else '%s-dryrun-B%d-T%d' % (name, B, T))
     module, cfg, batch, state = headline_setup(
-        B, T, dtype=jnp.bfloat16 if dtype == 'bf16' else None)
+        B, T, dtype=jnp.bfloat16 if dtype == 'bf16' else None,
+        torus_impl=torus_impl)
     if cast_state:
         # params AND Adam moments in bf16: halves the read+write traffic
         # of every weight and optimizer buffer
@@ -120,7 +121,7 @@ def variant(name, dtype=None, cast_state=False, B=HEADLINE_B, T=HEADLINE_T,
         row['top_ops'] = [{k: r[k] for k in ('op', 'bytes')}
                           for r in table[:8]]
         row['sum_table_bytes'] = total
-        if name == 'bf16-act':   # base name: the print path runs in dry-runs too
+        if name in ('bf16-act', 'bf16-act+halo'):   # base name: the print path runs in dry-runs too
             print('--- per-op traffic, %s (top 25) ---' % tagged)
             for r in table:
                 print('%12d  %-18s %s' % (r['bytes'], r['op'], r['name']))
@@ -148,7 +149,12 @@ def main():
     for name, kw in (('fp32', {}),
                      ('bf16-act', {'dtype': 'bf16'}),
                      ('bf16-act+state', {'dtype': 'bf16',
-                                         'cast_state': True})):
+                                         'cast_state': True}),
+                     # halo torus conv: same function as bf16-act without
+                     # the wrap-pad HBM copies (models/blocks.py) — the
+                     # round-5 per-op table's named target
+                     ('bf16-act+halo', {'dtype': 'bf16',
+                                        'torus_impl': 'halo'})):
         row = variant(name, steps=steps, B=B, T=T, **kw)
         print(json.dumps(row), flush=True)
         with open(os.path.abspath(out), 'a') as f:
