@@ -1,0 +1,230 @@
+"""Fused GeeseNet trunk as one Pallas TPU kernel.
+
+Why: the round-5 per-op HBM table (BENCHMARKS.md) shows the GeeseNet
+update step is bound by per-conv materialization — wrap-pad copies and
+im2col patch buffers written to HBM for every one of the 13 torus-conv
+layers, forward and backward. The whole trunk is tiny (weights ~240 KB,
+a 64-sample activation tile ~1 MB), so the entire 13-layer stack fits in
+VMEM: one kernel reads an observation tile from HBM once, runs
+stem + 12 residual blocks on-chip, and writes the final feature map
+once. The backward kernel recomputes the tile forward in VMEM
+(flash-attention-style rematerialization) and gets exact gradients by
+calling ``jax.vjp`` on the SAME tile function inside the kernel — no
+hand-derived chain rule to get wrong — accumulating weight grads across
+the (sequential) TPU grid.
+
+This is the capability peer of the reference GeeseNet trunk
+(hungry_geese.py:23-50: TorusConv2d stem + 12 residual blocks); the
+function is pinned against the Flax module stack by
+tests/test_pallas_geese.py, and GeeseNet(torus_impl='pallas') routes
+through it with the exact same parameter tree.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+# ---------------------------------------------------------------- tile math
+
+def _torus_conv(h, w, out_dtype):
+    """3x3 torus conv on a VMEM-resident tile. h (B,7,11,C), w (3,3,C,F).
+
+    Wrap-pad via concatenate (VMEM copies, never HBM), then 9 tap
+    matmuls accumulated in fp32 — the MXU path Mosaic lowers dot_general
+    to; fp32 accumulation matches XLA's conv behavior for bf16 inputs.
+    Dots are kept strictly 2-D ((B*7*11, C) x (C, F)): Mosaic rejects
+    multi-non-contracting-dim dot_generals, and merging/splitting LEADING
+    dims is a free row-major relayout (splitting the lane dim is not)."""
+    B = h.shape[0]
+    F = w.shape[-1]
+    hp = jnp.concatenate([h[:, -1:], h, h[:, :1]], axis=1)
+    hp = jnp.concatenate([hp[:, :, -1:], hp, hp[:, :, :1]], axis=2)
+    acc = None
+    for a in range(3):
+        for b in range(3):
+            patch = hp[:, a:a + 7, b:b + 11].reshape(B * 77, -1)
+            t = jax.lax.dot_general(
+                patch, w[a, b], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = t if acc is None else acc + t
+    return acc.reshape(B, 7, 11, F).astype(out_dtype)
+
+
+def _group_norm(h, scale, bias, groups, eps=1e-6):
+    """flax nn.GroupNorm semantics: per-sample stats over spatial dims and
+    the channels of each group, fp32 statistics.
+
+    Group reductions go through a one-hot (C, G) matmul instead of the
+    textbook reshape to (..., G, C/G): splitting the channel (lane) dim
+    is an unsupported shape cast in Mosaic, while matmuls and leading-dim
+    reductions lower fine. E[x^2]-E[x]^2 replaces the two-pass variance;
+    fp32 accumulation keeps it stable at GroupNorm's O(1) activations."""
+    B, H, W, C = h.shape
+    cpg = C // groups
+    row = jax.lax.broadcasted_iota(jnp.int32, (C, groups), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (C, groups), 1)
+    M = (row // cpg == col).astype(jnp.float32)          # (C, G)
+    hf = h.astype(jnp.float32).reshape(B, H * W, C)
+    n = float(H * W * cpg)
+    s1 = jax.lax.dot_general(hf.reshape(-1, C), M, (((1,), (0,)), ((), ())))
+    s2 = jax.lax.dot_general((hf * hf).reshape(-1, C), M,
+                             (((1,), (0,)), ((), ())))
+    s1 = s1.reshape(B, H * W, groups).sum(axis=1)        # (B, G)
+    s2 = s2.reshape(B, H * W, groups).sum(axis=1)
+    mean_g = s1 / n
+    rstd_g = jax.lax.rsqrt(jnp.maximum(s2 / n - mean_g ** 2, 0.0) + eps)
+    # broadcast per-group stats back to channels via (G, C) matmul
+    mean_c = jax.lax.dot_general(mean_g, M.T, (((1,), (0,)), ((), ())))
+    rstd_c = jax.lax.dot_general(rstd_g, M.T, (((1,), (0,)), ((), ())))
+    hn = (hf - mean_c[:, None, :]) * rstd_c[:, None, :]
+    return (hn.reshape(h.shape) * scale + bias).astype(h.dtype)
+
+
+def tile_forward(x, stem_w, stem_scale, stem_bias,
+                 block_w, block_scale, block_bias, *, groups, dtype):
+    """The trunk on one batch tile, all operands VMEM-resident.
+
+    x (B,7,11,Cin); stem_w (3,3,Cin,F); block_w (L,3,3,F,F);
+    scales/biases (F,) and (L,F). Mirrors GeeseNet exactly:
+    relu(norm(conv(x))) stem, then L x relu(h + norm(conv(h)))."""
+    x = x.astype(dtype)
+    h = _torus_conv(x, stem_w.astype(dtype), dtype)
+    h = jax.nn.relu(_group_norm(h, stem_scale, stem_bias, groups))
+    for i in range(block_w.shape[0]):
+        c = _torus_conv(h, block_w[i].astype(dtype), dtype)
+        c = _group_norm(c, block_scale[i], block_bias[i], groups)
+        h = jax.nn.relu(h + c)
+    return h
+
+
+# ---------------------------------------------------------------- kernels
+
+def _fwd_kernel(x_ref, sw_ref, ss_ref, sb_ref, bw_ref, bs_ref, bb_ref,
+                out_ref, *, groups, dtype):
+    out_ref[...] = tile_forward(
+        x_ref[...], sw_ref[...], ss_ref[...], sb_ref[...],
+        bw_ref[...], bs_ref[...], bb_ref[...], groups=groups, dtype=dtype)
+
+
+def _bwd_kernel(x_ref, sw_ref, ss_ref, sb_ref, bw_ref, bs_ref, bb_ref,
+                dy_ref, dx_ref, dsw_ref, dss_ref, dsb_ref, dbw_ref,
+                dbs_ref, dbb_ref, *, groups, dtype):
+    """Recompute the tile forward and transpose it with jax.vjp, entirely
+    in VMEM. Weight grads accumulate across the sequential TPU grid."""
+    fn = functools.partial(tile_forward, groups=groups, dtype=dtype)
+    _, vjp = jax.vjp(fn, x_ref[...], sw_ref[...], ss_ref[...], sb_ref[...],
+                     bw_ref[...], bs_ref[...], bb_ref[...])
+    dx, dsw, dss, dsb, dbw, dbs, dbb = vjp(dy_ref[...].astype(dtype))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        for r in (dsw_ref, dss_ref, dsb_ref, dbw_ref, dbs_ref, dbb_ref):
+            r[...] = jnp.zeros_like(r)
+
+    for r, g in ((dsw_ref, dsw), (dss_ref, dss), (dsb_ref, dsb),
+                 (dbw_ref, dbw), (dbs_ref, dbs), (dbb_ref, dbb)):
+        r[...] += g.astype(r.dtype)
+
+
+# ------------------------------------------------------------- public entry
+
+def _specs(weight_arrays, tile, x_shape):
+    """BlockSpecs: batch-tiled x (block-index convention: grid step i
+    reads block i along the batch dim), whole-array weights (block 0
+    along every dim — identical under either index-map convention)."""
+    xs = pl.BlockSpec((tile,) + x_shape[1:], lambda i: (i, 0, 0, 0))
+    ws = [pl.BlockSpec(a.shape, (lambda nd: (lambda i: (0,) * nd))(a.ndim))
+          for a in weight_arrays]
+    return xs, ws
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def trunk_apply(x, stem_w, stem_scale, stem_bias, block_w, block_scale,
+                block_bias, groups=8, tile=64, interpret=False):
+    """Fused trunk: (N,7,11,Cin) -> (N,7,11,F). N must divide by tile."""
+    return _trunk_fwd(x, stem_w, stem_scale, stem_bias, block_w,
+                      block_scale, block_bias, groups, tile, interpret)[0]
+
+
+def _trunk_fwd(x, stem_w, stem_scale, stem_bias, block_w, block_scale,
+               block_bias, groups, tile, interpret):
+    N = x.shape[0]
+    assert N % tile == 0, (N, tile)
+    dtype = x.dtype
+    F = stem_w.shape[-1]
+    weights = (stem_w, stem_scale, stem_bias, block_w, block_scale,
+               block_bias)
+    xs, ws = _specs(weights, tile, x.shape)
+    y = pl.pallas_call(
+        functools.partial(_fwd_kernel, groups=groups, dtype=dtype),
+        grid=(N // tile,),
+        in_specs=[xs] + ws,
+        out_specs=pl.BlockSpec((tile, 7, 11, F), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, 7, 11, F), dtype),
+        interpret=interpret,
+    )(x, stem_w, stem_scale, stem_bias, block_w, block_scale, block_bias)
+    return y, (x, stem_w, stem_scale, stem_bias, block_w, block_scale,
+               block_bias)
+
+
+def _trunk_bwd(groups, tile, interpret, res, dy):
+    x, stem_w, stem_scale, stem_bias, block_w, block_scale, block_bias = res
+    N = x.shape[0]
+    dtype = x.dtype
+    F = stem_w.shape[-1]
+    weights = (stem_w, stem_scale, stem_bias, block_w, block_scale,
+               block_bias)
+    xs, ws = _specs(weights, tile, x.shape)
+    dy_spec = pl.BlockSpec((tile, 7, 11, F), lambda i: (i, 0, 0, 0))
+    # weight-grad outputs are revisited on every grid step (sequential on
+    # TPU), so the kernel zero-initializes at step 0 and accumulates
+    grad_specs = [pl.BlockSpec(a.shape,
+                               (lambda nd: (lambda i: (0,) * nd))(a.ndim))
+                  for a in weights]
+    grad_shapes = [jax.ShapeDtypeStruct(a.shape, jnp.float32)
+                   for a in weights]
+    out = pl.pallas_call(
+        functools.partial(_bwd_kernel, groups=groups, dtype=dtype),
+        grid=(N // tile,),
+        in_specs=[xs] + ws + [dy_spec],
+        out_specs=[xs] + grad_specs,
+        out_shape=[jax.ShapeDtypeStruct(x.shape, dtype)] + grad_shapes,
+        interpret=interpret,
+    )(x, stem_w, stem_scale, stem_bias, block_w, block_scale, block_bias, dy)
+    dx = out[0]
+    dws = [g.astype(a.dtype) for g, a in zip(
+        out[1:], (stem_w, stem_scale, stem_bias, block_w, block_scale,
+                  block_bias))]
+    return (dx,) + tuple(dws)
+
+
+trunk_apply.defvjp(_trunk_fwd, _trunk_bwd)
+
+
+# --------------------------------------------------- flax param extraction
+
+def trunk_params_from_geesenet(params, layers=12) -> Tuple[jnp.ndarray, ...]:
+    """Stack the GeeseNet trunk's Flax params (TorusConv_i/{Conv_0,
+    GroupNorm_0}) into the kernel's operand arrays. The param TREE is
+    owned by the Flax modules — this is a read-only view, so checkpoints
+    and optimizer state are impl-agnostic."""
+    p = params['params'] if 'params' in params else params
+    stem = p['TorusConv_0']
+    stem_w = stem['Conv_0']['kernel']
+    stem_scale = stem['GroupNorm_0']['scale']
+    stem_bias = stem['GroupNorm_0']['bias']
+    bw, bs, bb = [], [], []
+    for i in range(1, layers + 1):
+        blk = p['TorusConv_%d' % i]
+        bw.append(blk['Conv_0']['kernel'])
+        bs.append(blk['GroupNorm_0']['scale'])
+        bb.append(blk['GroupNorm_0']['bias'])
+    return (stem_w, stem_scale, stem_bias,
+            jnp.stack(bw), jnp.stack(bs), jnp.stack(bb))

@@ -100,6 +100,19 @@ def variant(name, dtype=None, cast_state=False, torus_impl=None,
     module, cfg, batch, state = headline_setup(
         B, T, dtype=jnp.bfloat16 if dtype == 'bf16' else None,
         torus_impl=torus_impl)
+    parity = None
+    if torus_impl is not None:
+        # numerics probe of the REAL lowering (interpret mode and Mosaic
+        # are different executors): forward the same params/obs through
+        # the wrap-pad twin and this impl before timing anything
+        obs = batch['observation'][:64, 0, 0]
+        ref = module.clone(torus_impl='pad').apply(state.params, obs, None)
+        got = module.apply(state.params, obs, None)
+        err = max(float(jnp.abs(jnp.asarray(ref[k], jnp.float32)
+                                - jnp.asarray(got[k], jnp.float32)).max())
+                  for k in ('policy', 'value'))
+        parity = {'max_abs_err_vs_pad': err, 'ok': bool(err < 0.05)}
+        print('parity[%s]: %s' % (tagged, parity), flush=True)
     if cast_state:
         # params AND Adam moments in bf16: halves the read+write traffic
         # of every weight and optimizer buffer
@@ -114,6 +127,8 @@ def variant(name, dtype=None, cast_state=False, torus_impl=None,
            'traj_per_sec': round(B / sec, 1),
            'flops_per_step': flops, 'hbm_bytes_per_step': hbm,
            'time': time.strftime('%Y-%m-%d %H:%M:%S')}
+    if parity is not None:
+        row['parity'] = parity
     # per-op table for the bf16-activation variant (the headline config)
     try:
         compiled = step.lower(state, batch, lr).compile()
@@ -121,7 +136,7 @@ def variant(name, dtype=None, cast_state=False, torus_impl=None,
         row['top_ops'] = [{k: r[k] for k in ('op', 'bytes')}
                           for r in table[:8]]
         row['sum_table_bytes'] = total
-        if name in ('bf16-act', 'bf16-act+halo'):   # base name: the print path runs in dry-runs too
+        if name in ('bf16-act', 'bf16-act+halo', 'bf16-act+pallas'):   # base name: the print path runs in dry-runs too
             print('--- per-op traffic, %s (top 25) ---' % tagged)
             for r in table:
                 print('%12d  %-18s %s' % (r['bytes'], r['op'], r['name']))
@@ -154,7 +169,12 @@ def main():
                      # the wrap-pad HBM copies (models/blocks.py) — the
                      # round-5 per-op table's named target
                      ('bf16-act+halo', {'dtype': 'bf16',
-                                        'torus_impl': 'halo'})):
+                                        'torus_impl': 'halo'}),
+                     # whole trunk fused into one VMEM-resident Pallas
+                     # kernel (ops/pallas_geese.py) — activations never
+                     # round-trip HBM between the 13 conv layers
+                     ('bf16-act+pallas', {'dtype': 'bf16',
+                                          'torus_impl': 'pallas'})):
         row = variant(name, steps=steps, B=B, T=T, **kw)
         print(json.dumps(row), flush=True)
         with open(os.path.abspath(out), 'a') as f:
