@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 SECOND chip window: the first window (scripts/chip_window.sh)
+# drained at 07:02; the tunnel then wedged (grant held by a hard-killed
+# probe client — the round-1 failure mode, reconfirmed). This queue
+# fires when the tunnel heals. Same discipline: SIGINT-only timeouts,
+# never kill -9 a chip client.
+#
+# Priority: (1) resume the north-star run toward 1M episodes — the first
+# window's run hit the default 600-epoch cap after 60k episodes; the cap
+# fix makes --budget-s govern. (2) measure the halo/pallas torus-conv
+# variants (with the in-run parity probe). (3) longer geister
+# spatial-head arms — the first window measured sp-bn 0.533 vs baseline
+# 0.434 at 3.2k episodes (2.3 sigma; needs power). (4) re-score the
+# extended north-star checkpoints at 1k games/point. (5) headline bench.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=
+LOG_DIR=${LOG_DIR:-/tmp/chip_window2/$(date +%m%d_%H%M%S)}
+NS_BUDGET_S=${NS_BUDGET_S:-14400}
+mkdir -p "$LOG_DIR"
+
+note() { echo "$(date +%H:%M:%S) $*" >> "$LOG_DIR/queue.log"; }
+
+run_item() {  # run_item NAME BUDGET_S CMD...
+  local name=$1 budget=$2; shift 2
+  note "START $name (budget ${budget}s): $*"
+  timeout --signal=INT "$budget" "$@" > "$LOG_DIR/$name.log" 2>&1
+  note "END   $name rc=$?"
+}
+
+note "=== chip window 2 opened ==="
+
+run_item north_star $((NS_BUDGET_S + 600)) \
+  python scripts/run_north_star.py --budget-s "$NS_BUDGET_S" \
+    --metrics-out north_star_device_r5.jsonl
+
+run_item hbm_experiments 2400 python scripts/hbm_experiments.py
+
+run_item geister_arms 5400 \
+  python scripts/run_benchmark_matrix.py geister-fused geister-fused-sp-bn \
+    --epochs=120
+
+run_item ns_rescore_random 3600 \
+  python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
+    north_star_device_curve_r5.jsonl --every 25 --games 1000 --skip-scored
+run_item ns_rescore_rulebase 5400 \
+  python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
+    north_star_device_curve_rulebase_r5.jsonl --every 25 --games 1000 \
+    --opponent rulebase --skip-scored
+
+BENCH_DEADLINE_SEC=900 run_item bench 960 python bench.py
+
+note "=== queue 2 drained ==="

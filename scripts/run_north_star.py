@@ -104,6 +104,12 @@ def main():
     raw['train_args']['model_dir'] = model_dir
     raw['train_args']['metrics_jsonl'] = (metrics_out or
                                           'north_star_%s.jsonl' % tag)
+    if budget_s is not None and epochs == 600:
+        # budget governs: the round-5 chip run stopped at the DEFAULT
+        # 600-epoch cap after 17 min of a 150-min budget. With an
+        # explicit --budget-s and no explicit --epochs, let the deadline
+        # be the only stop.
+        epochs = 10 ** 6
     raw['train_args']['epochs'] = epochs
     start = latest_epoch(model_dir)
     raw['train_args']['restart_epoch'] = start
