@@ -290,6 +290,32 @@ def run_bench(probe: dict):
          mfu=round(mfu, 4), mbu=round(mbu, 4), roofline_bound=bound)
 
 
+def _last_measured() -> str:
+    """The newest on-silicon bench-headline row, summarized for the
+    backend-unavailable JSON line — so a wedged tunnel at the driver's
+    round-end run still points at the concrete measured number."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks.jsonl')
+    try:
+        best = None
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if (row.get('row') == 'bench-headline'
+                        and row.get('backend') == 'tpu'):
+                    best = row
+        if best is None:
+            return 'none recorded'
+        return '%.1f traj/s (%.1fx baseline) on %s at %s' % (
+            best.get('value', 0.0), best.get('vs_baseline', 0.0),
+            best.get('device', '?'), best.get('time', '?'))
+    except OSError:
+        return 'none recorded'
+
+
 def main():
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
@@ -299,9 +325,10 @@ def main():
 
     probe = probe_backend(min(120.0, deadline / 3))
     if 'error' in probe:
+        last = _last_measured()
         emit(error='backend unavailable: ' + probe['error'],
-             note='last measured TPU v5e value for this metric is in '
-                  'BENCHMARKS.md / benchmarks.jsonl (bf16 row)')
+             note='last measured TPU value for this metric: '
+                  '%s (benchmarks.jsonl bench-headline rows)' % (last,))
         return
     try:
         run_bench(probe)
