@@ -21,11 +21,21 @@ mkdir -p "$LOG_DIR"
 
 note() { echo "$(date +%H:%M:%S) $*" >> "$LOG_DIR/queue.log"; }
 
+# Per-item done markers make the queue RE-ENTRANT: a mid-queue tunnel
+# wedge (or a supervisor restart) re-runs only unfinished items. The
+# stateful items are idempotent anyway (north_star resumes from its
+# checkpoints, rescores use --skip-scored).
 run_item() {  # run_item NAME BUDGET_S CMD...
   local name=$1 budget=$2; shift 2
+  if [ -e "$LOG_DIR/done.$name" ]; then
+    note "SKIP  $name (done marker)"
+    return 0
+  fi
   note "START $name (budget ${budget}s): $*"
   timeout --signal=INT "$budget" "$@" > "$LOG_DIR/$name.log" 2>&1
-  note "END   $name rc=$?"
+  local rc=$?
+  note "END   $name rc=$rc"
+  [ "$rc" -eq 0 ] && touch "$LOG_DIR/done.$name"
 }
 
 note "=== chip window 2 opened ==="

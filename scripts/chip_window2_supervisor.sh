@@ -1,0 +1,40 @@
+#!/bin/bash
+# Supervise the round-5 second chip window across tunnel outages: probe
+# until the backend answers, run the (re-entrant) queue, and if the
+# queue exits with items unfinished — a mid-queue wedge — go back to
+# probing. Stops when every queue item has its done marker or MAX_TRIES
+# windows have been spent. Chip discipline unchanged: SIGINT-only
+# budgets inside chip_window2.sh, never kill -9.
+set -u
+cd "$(dirname "$0")/.."
+LOG_DIR=${LOG_DIR:-/tmp/chip_window2/r5}
+PROBE_LOG=${PROBE_LOG:-/tmp/tpu_probe_r5.log}
+MAX_TRIES=${MAX_TRIES:-40}
+ITEMS="north_star hbm_experiments geister_arms ns_rescore_random ns_rescore_rulebase bench"
+mkdir -p "$LOG_DIR"
+
+all_done() {
+  for it in $ITEMS; do
+    [ -e "$LOG_DIR/done.$it" ] || return 1
+  done
+  return 0
+}
+
+for try in $(seq 1 "$MAX_TRIES"); do
+  if all_done; then
+    echo "$(date +%H:%M:%S) supervisor: all items done" >> "$LOG_DIR/queue.log"
+    exit 0
+  fi
+  bash scripts/tpu_probe_loop.sh "$PROBE_LOG" 300 || exit 1
+  # North-star budget: whatever gets closest to the 1M-episode endpoint
+  # (~16800 s at the measured 57.4 eps/s on top of the 60k in the bank)
+  # without pushing the rest of the queue past the round's tail — cap
+  # at a 16:00 cutoff, floor at 30 min so a late window still extends
+  # the curve meaningfully.
+  now=$(date +%s); cutoff=$(date -d '16:00' +%s 2>/dev/null || echo "$now")
+  ns=$(( cutoff - now )); [ "$ns" -gt 16800 ] && ns=16800
+  [ "$ns" -lt 1800 ] && ns=1800
+  echo "$(date +%H:%M:%S) supervisor: window $try (NS_BUDGET_S=$ns)" >> "$LOG_DIR/queue.log"
+  LOG_DIR="$LOG_DIR" NS_BUDGET_S="$ns" bash scripts/chip_window2.sh
+done
+echo "$(date +%H:%M:%S) supervisor: gave up after $MAX_TRIES windows" >> "$LOG_DIR/queue.log"
