@@ -348,7 +348,8 @@ class Environment(BaseEnvironment):
         # investigation knob (BENCHMARKS.md Geister quality-gap section)
         # without a source edit
         return GeisterNet(norm_kind=self.args.get('norm_kind', 'group'),
-                          policy_head=self.args.get('policy_head', 'dense'))
+                          policy_head=self.args.get('policy_head', 'dense'),
+                          init_kind=self.args.get('init_kind', 'flax'))
 
     def __str__(self) -> str:
         def glyph(piece):

@@ -30,6 +30,45 @@ def to_nhwc(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(x, -3, -1)
 
 
+def torch_default_inits(fan_in: int):
+    """(kernel_init, bias_init) mirroring torch's Conv2d/Linear defaults:
+    kaiming_uniform(a=sqrt(5)) == uniform(+-1/sqrt(fan_in)) for the kernel
+    (std 1.73x SMALLER than flax's lecun_normal default) and
+    uniform(+-1/sqrt(fan_in)) for the bias (flax default: zeros). fan_in
+    counts receptive field x channels for convs, in_features for dense.
+    An init-dynamics knob for the Geister early-curve investigation —
+    weight DISTRIBUTIONS differ between frameworks even when every
+    architectural choice matches (torch nn/init kaiming_uniform +
+    Conv2d/Linear reset_parameters semantics)."""
+    kernel = nn.initializers.variance_scaling(1.0 / 3.0, 'fan_in', 'uniform')
+    bound = 1.0 / (fan_in ** 0.5)
+
+    def bias(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return kernel, bias
+
+
+def conv_inits(init_kind: str, in_ch: int, kernel: int) -> dict:
+    """kwargs for nn.Conv under the given init regime ('flax' = defaults)."""
+    if init_kind == 'flax':
+        return {}
+    if init_kind == 'torch':
+        k, b = torch_default_inits(in_ch * kernel * kernel)
+        return {'kernel_init': k, 'bias_init': b}
+    raise ValueError('unknown init_kind %r' % (init_kind,))
+
+
+def dense_inits(init_kind: str, in_features: int) -> dict:
+    """kwargs for nn.Dense under the given init regime."""
+    if init_kind == 'flax':
+        return {}
+    if init_kind == 'torch':
+        k, b = torch_default_inits(in_features)
+        return {'kernel_init': k, 'bias_init': b}
+    raise ValueError('unknown init_kind %r' % (init_kind,))
+
+
 class BatchStatsNorm(nn.Module):
     """(norm_kind='batchstats' — the round-4 investigation variant, kept
     for the A/B record; 'batch' is now full nn.BatchNorm with running
@@ -118,12 +157,14 @@ class ConvBlock(nn.Module):
     kernel: int = 3
     norm: bool = True
     norm_kind: str = 'group'
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.filters, (self.kernel, self.kernel), padding='SAME',
-                    use_bias=not self.norm, dtype=self.dtype)(x)
+                    use_bias=not self.norm, dtype=self.dtype,
+                    **conv_inits(self.init_kind, x.shape[-1], self.kernel))(x)
         if self.norm:
             x = make_norm(self.norm_kind, self.filters, self.dtype, train)(x)
         return x
@@ -241,15 +282,18 @@ class SpatialPolicyHead(nn.Module):
     filters: int
     out_filters: int
     norm_kind: str = 'group1'
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(self.filters, (3, 3), padding='SAME', use_bias=False,
-                    dtype=self.dtype)(x)
+                    dtype=self.dtype,
+                    **conv_inits(self.init_kind, x.shape[-1], 3))(x)
         h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
         h = nn.relu(h)
-        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype)(h)
+        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype,
+                    **conv_inits(self.init_kind, self.filters, 1))(h)
         h = jnp.moveaxis(h, -1, -3)            # (..., F, H, W)
         return h.reshape(*h.shape[:-3], -1)
 
@@ -258,14 +302,17 @@ class PolicyHead(nn.Module):
     """1x1 conv squeeze -> leaky-relu -> dense logits (no bias)."""
     out_filters: int
     outputs: int
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype)(x)
+        h = nn.Conv(self.out_filters, (1, 1), dtype=self.dtype,
+                    **conv_inits(self.init_kind, x.shape[-1], 1))(x)
         h = nn.leaky_relu(h, negative_slope=0.1)
         h = h.reshape(*h.shape[:-3], -1)
-        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)
+        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype,
+                        **dense_inits(self.init_kind, h.shape[-1]))(h)
 
 
 class ScalarHead(nn.Module):
@@ -273,15 +320,18 @@ class ScalarHead(nn.Module):
     filters: int
     outputs: int = 1
     norm_kind: str = 'group1'
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        h = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    **conv_inits(self.init_kind, x.shape[-1], 1))(x)
         h = make_norm(self.norm_kind, self.filters, self.dtype, train)(h)
         h = nn.relu(h)
         h = h.reshape(*h.shape[:-3], -1)
-        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype)(h)
+        return nn.Dense(self.outputs, use_bias=False, dtype=self.dtype,
+                        **dense_inits(self.init_kind, h.shape[-1]))(h)
 
 
 class ConvLSTMCell(nn.Module):
@@ -292,14 +342,17 @@ class ConvLSTMCell(nn.Module):
     """
     features: int
     kernel: int = 3
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, state):
         h_prev, c_prev = state
+        xin = jnp.concatenate([x, h_prev], axis=-1)
         gates = nn.Conv(4 * self.features, (self.kernel, self.kernel),
-                        padding='SAME', dtype=self.dtype)(
-            jnp.concatenate([x, h_prev], axis=-1))
+                        padding='SAME', dtype=self.dtype,
+                        **conv_inits(self.init_kind, xin.shape[-1],
+                                     self.kernel))(xin)
         i, f, o, g = jnp.split(gates, 4, axis=-1)
         c = nn.sigmoid(f) * c_prev + nn.sigmoid(i) * jnp.tanh(g)
         h = nn.sigmoid(o) * jnp.tanh(c)
@@ -317,6 +370,7 @@ class DRC(nn.Module):
     features: int = 32
     kernel: int = 3
     num_repeats: int = 3
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     def initial_state(self, spatial: Sequence[int], batch_shape=()):
@@ -330,7 +384,8 @@ class DRC(nn.Module):
     def __call__(self, x, state):
         if state is None:
             state = self.initial_state(x.shape[-3:-1], x.shape[:-3])
-        cells = [ConvLSTMCell(self.features, self.kernel, dtype=self.dtype)
+        cells = [ConvLSTMCell(self.features, self.kernel,
+                              init_kind=self.init_kind, dtype=self.dtype)
                  for _ in range(self.num_layers)]
         hs, cs = list(state[0]), list(state[1])
         for _ in range(self.num_repeats):

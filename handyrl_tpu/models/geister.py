@@ -40,6 +40,13 @@ class GeisterNet(nn.Module):
     # cell logits see their own 3x3 neighborhood instead of learning a
     # global 288->144 dense map. Default follows BENCHMARKS.md verdicts.
     policy_head: str = 'dense'
+    # 'torch' reproduces the reference framework's default weight
+    # distributions (kaiming-uniform kernels, uniform biases —
+    # blocks.torch_default_inits); 'flax' is this repo's measured
+    # baseline (lecun_normal, zero biases). Initialization is the
+    # remaining dynamics suspect for the early-curve Geister gap after
+    # norm + head were measured (BENCHMARKS.md).
+    init_kind: str = 'flax'
     dtype: jnp.dtype = jnp.float32
 
     def init_hidden(self, batch_shape=()):
@@ -64,26 +71,34 @@ class GeisterNet(nn.Module):
         # exactly; only 'batch' switches the heads' statistics
         head_norm = 'group1' if self.norm_kind == 'group' else self.norm_kind
         h = nn.relu(ConvBlock(self.filters, norm_kind=self.norm_kind,
+                              init_kind=self.init_kind,
                               dtype=self.dtype)(x, train))
         body = DRC(self.drc_layers, self.filters,
-                   num_repeats=self.drc_repeats, dtype=self.dtype)
+                   num_repeats=self.drc_repeats, init_kind=self.init_kind,
+                   dtype=self.dtype)
         if hidden is None:
             hidden = self.init_hidden(h.shape[:-3])
         h, next_hidden = body(h, hidden)
 
         if self.policy_head == 'spatial':
             p_move = SpatialPolicyHead(8, 4, norm_kind=head_norm,
+                                       init_kind=self.init_kind,
                                        dtype=self.dtype)(h, train)
         else:
-            p_move = PolicyHead(8, 4 * 36, dtype=self.dtype)(h)
+            p_move = PolicyHead(8, 4 * 36, init_kind=self.init_kind,
+                                dtype=self.dtype)(h)
         # setup-phase logits conditioned only on the side-to-move bit
         turn_color = scalar[..., :1]
-        p_set = nn.Dense(70, dtype=self.dtype)(turn_color)
+        from .blocks import dense_inits
+        p_set = nn.Dense(70, dtype=self.dtype,
+                         **dense_inits(self.init_kind, 1))(turn_color)
         policy = jnp.concatenate([p_move, p_set], axis=-1)
 
         value = jnp.tanh(ScalarHead(2, 1, norm_kind=head_norm,
+                                    init_kind=self.init_kind,
                                     dtype=self.dtype)(h, train))
         ret = ScalarHead(2, 1, norm_kind=head_norm,
+                         init_kind=self.init_kind,
                          dtype=self.dtype)(h, train)
         return {'policy': policy, 'value': value, 'return': ret,
                 'hidden': next_hidden}

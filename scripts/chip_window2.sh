@@ -48,7 +48,7 @@ run_item hbm_experiments 2400 python scripts/hbm_experiments.py
 
 run_item geister_arms 5400 \
   python scripts/run_benchmark_matrix.py geister-fused geister-fused-sp-bn \
-    --epochs=120
+    geister-fused-sp-bn-ti --epochs=120
 
 run_item ns_rescore_random 3600 \
   python scripts/eval_checkpoints.py models_north_star_device HungryGeese \

@@ -134,7 +134,13 @@ for _base, _twin in (('geister-fused', 'geister-fused-bn'),
 # most reference-faithful GeisterNet this repo can express).
 for _twin, _extra in (('geister-fused-sp', {'policy_head': 'spatial'}),
                       ('geister-fused-sp-bn', {'policy_head': 'spatial',
-                                               'norm_kind': 'batch'})):
+                                               'norm_kind': 'batch'}),
+                      # + torch-default weight distributions
+                      # (blocks.torch_default_inits) — the remaining
+                      # dynamics suspect after head+norm measured +0.10
+                      ('geister-fused-sp-bn-ti', {'policy_head': 'spatial',
+                                                  'norm_kind': 'batch',
+                                                  'init_kind': 'torch'})):
     _row = json.loads(json.dumps(ROWS['geister-fused']))
     _row['env_args'].update(_extra)
     ROWS[_twin] = _row
