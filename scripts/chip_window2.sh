@@ -50,6 +50,21 @@ run_item geister_arms 5400 \
   python scripts/run_benchmark_matrix.py geister-fused geister-fused-sp-bn \
     geister-fused-sp-bn-ti --epochs=120
 
+# 1k-game rescores of the arm checkpoints (SE +-1.6% vs the ~255-game
+# online rates): the decisive power for ranking the arms. --env-args
+# must rebuild each arm's exact net so the checkpoint param tree loads.
+run_item geister_rescore_base 1800 \
+  python scripts/eval_checkpoints.py models_bench_geister-fused Geister \
+    geister_arm_base_r5.jsonl --every 20 --games 1000 --skip-scored
+run_item geister_rescore_spbn 1800 \
+  python scripts/eval_checkpoints.py models_bench_geister-fused-sp-bn \
+    Geister geister_arm_spbn_r5.jsonl --every 20 --games 1000 \
+    --skip-scored --env-args '{"policy_head": "spatial", "norm_kind": "batch"}'
+run_item geister_rescore_spbnti 1800 \
+  python scripts/eval_checkpoints.py models_bench_geister-fused-sp-bn-ti \
+    Geister geister_arm_spbnti_r5.jsonl --every 20 --games 1000 \
+    --skip-scored --env-args '{"policy_head": "spatial", "norm_kind": "batch", "init_kind": "torch"}'
+
 run_item ns_rescore_random 3600 \
   python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
     north_star_device_curve_r5.jsonl --every 25 --games 1000 --skip-scored

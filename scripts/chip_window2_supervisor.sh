@@ -10,7 +10,7 @@ cd "$(dirname "$0")/.."
 LOG_DIR=${LOG_DIR:-/tmp/chip_window2/r5}
 PROBE_LOG=${PROBE_LOG:-/tmp/tpu_probe_r5.log}
 MAX_TRIES=${MAX_TRIES:-40}
-ITEMS="north_star hbm_experiments geister_arms ns_rescore_random ns_rescore_rulebase bench"
+ITEMS="north_star hbm_experiments geister_arms geister_rescore_base geister_rescore_spbn geister_rescore_spbnti ns_rescore_random ns_rescore_rulebase bench"
 mkdir -p "$LOG_DIR"
 
 all_done() {
