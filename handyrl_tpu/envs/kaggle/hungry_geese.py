@@ -281,7 +281,12 @@ class Environment(BaseEnvironment):
         # env_args {'norm_kind': 'batch'} selects full BatchNorm in the
         # stem + all blocks (reference TorusConv2d's nn.BatchNorm2d,
         # hungry_geese.py:23-35,43-44) — the round-5 norm A/B knob
-        from ...models.geese import GeeseNet
+        from ...models.geese import GeeseNet, GeeseNetLSTM
+        if self.args.get('net_kind', 'conv') == 'lstm':
+            # the LSTM-era baseline configuration (BASELINE.md row 4):
+            # torus-conv stem + ConvLSTM core carrying state across plies
+            return GeeseNetLSTM(norm_kind=self.args.get('norm_kind', 'group'),
+                                torus_impl=self.args.get('torus_impl', 'pad'))
         return GeeseNet(norm_kind=self.args.get('norm_kind', 'group'),
                         torus_impl=self.args.get('torus_impl', 'pad'))
 

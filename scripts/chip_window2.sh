@@ -65,6 +65,12 @@ run_item geister_rescore_spbnti 1800 \
     Geister geister_arm_spbnti_r5.jsonl --every 20 --games 1000 \
     --skip-scored --env-args '{"policy_head": "spatial", "norm_kind": "batch", "init_kind": "torch"}'
 
+# LSTM-era flagship configuration (BASELINE.md matrix row 4): recurrent
+# GeeseNetLSTM through the fused device pipeline, measured at the same
+# protocol as the norm A/B (bonus row — not in the supervisor gate)
+run_item geese_lstm 1800 \
+  python scripts/run_benchmark_matrix.py geese-lstm-device --epochs=10
+
 run_item ns_rescore_random 3600 \
   python scripts/eval_checkpoints.py models_north_star_device HungryGeese \
     north_star_device_curve_r5.jsonl --every 25 --games 1000 --skip-scored

@@ -129,6 +129,14 @@ for _base, _twin in (('geister-fused', 'geister-fused-bn'),
     _row['env_args']['norm_kind'] = 'batch'
     ROWS[_twin] = _row
 
+# the LSTM-era flagship configuration (BASELINE.md measurement-matrix
+# row 4: "Hungry Geese, 4-player self-play, LSTM model"): recurrent
+# GeeseNetLSTM through the same fused device pipeline — hidden state
+# carried across plies like GeisterNet's DRC, burn-in windows included
+ROWS['geese-lstm-device'] = json.loads(json.dumps(ROWS['geese-device']))
+ROWS['geese-lstm-device']['env_args']['net_kind'] = 'lstm'
+ROWS['geese-lstm-device']['train_args']['burn_in_steps'] = 4
+
 # geister arms for the round-5 spatial-policy-head hypothesis: 'sp' =
 # reference head structure alone, 'sp-bn' = head + full BatchNorm (the
 # most reference-faithful GeisterNet this repo can express).
