@@ -65,3 +65,31 @@ def test_unknown_init_kind_rejected():
     import pytest
     with pytest.raises(ValueError):
         GeisterNet(init_kind='typo').init(jax.random.PRNGKey(0), _obs(), None)
+
+
+def test_three_knob_arm_update_step(geister_batch_and_wrapper):
+    """One compiled update step on the full round-5 chip-arm config
+    (spatial head + full BatchNorm + torch init — geister-fused-sp-bn-ti)
+    so the combination cannot first fail mid-benchmark on the chip."""
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.model import ModelWrapper
+    from handyrl_tpu.ops.losses import LossConfig
+    from handyrl_tpu.ops.train_step import build_update_step, init_train_state
+
+    _, batch, args = geister_batch_and_wrapper
+    wrapper = ModelWrapper(GeisterNet(
+        filters=8, drc_layers=2, drc_repeats=1, norm_kind='batch',
+        policy_head='spatial', init_kind='torch'))
+    env = make_env({'env': 'Geister'})
+    env.reset()
+    wrapper.ensure_params(env.observation(0))
+    state = init_train_state(jax.tree_util.tree_map(jnp.array,
+                                                    wrapper.params))
+    update = build_update_step(wrapper.module, LossConfig.from_args(args),
+                               mesh=None, donate=False)
+    _, metrics = update(state, batch, jnp.float32(1e-3))
+    assert np.isfinite(float(metrics['total']))
+
+
+# reuse the sp-bn batch fixture from the batchnorm parity suite
+from tests.test_batchnorm_parity import geister_batch_and_wrapper  # noqa: E402,F401
