@@ -40,11 +40,13 @@ run_item() {  # run_item NAME BUDGET_S CMD...
 
 note "=== chip window 2 opened ==="
 
+# hbm first: ~4 min, and a halo/pallas win lets the torus default flip
+# before the final bench item (and the driver's round-end bench) runs
+run_item hbm_experiments 2400 python scripts/hbm_experiments.py
+
 run_item north_star $((NS_BUDGET_S + 600)) \
   python scripts/run_north_star.py --budget-s "$NS_BUDGET_S" \
     --metrics-out north_star_device_r5.jsonl
-
-run_item hbm_experiments 2400 python scripts/hbm_experiments.py
 
 run_item geister_arms 5400 \
   python scripts/run_benchmark_matrix.py geister-fused geister-fused-sp-bn \
