@@ -1,0 +1,83 @@
+"""Markdown comparison of the round-5 geister arms vs the measured
+torch-reference bar.
+
+Joins the 1k-game checkpoint rescores (geister_arm_*_r5.jsonl, written
+by the chip queue / eval_checkpoints.py) with the reference rows in
+benchmarks.jsonl (implementation='reference', row='geister' — the
+actual torch reference run on this host, round 4). Episode counts per
+epoch come from each arm's matrix row (benchmarks.jsonl rows
+geister-fused*). Standard errors are printed for every point: the
+reference bar itself is a 252-game measurement (SE +-3.1%), which
+bounds how small a 'gap' can still be called real.
+
+Usage: python scripts/geister_arm_report.py [--dir .]
+"""
+
+import json
+import math
+import os
+import sys
+
+ARMS = (('baseline (GroupNorm, dense head)', 'geister-fused',
+         'geister_arm_base_r5.jsonl'),
+        ('spatial head + BatchNorm', 'geister-fused-sp-bn',
+         'geister_arm_spbn_r5.jsonl'),
+        ('spatial + BatchNorm + torch init', 'geister-fused-sp-bn-ti',
+         'geister_arm_spbnti_r5.jsonl'))
+
+
+def _rows(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def se(p, n):
+    return math.sqrt(max(p * (1 - p), 1e-9) / n) if n else float('nan')
+
+
+def main():
+    base = '.'
+    if '--dir' in sys.argv:
+        base = sys.argv[sys.argv.index('--dir') + 1]
+    bench = _rows(os.path.join(base, 'benchmarks.jsonl'))
+
+    ref = [r for r in bench if r.get('implementation') == 'reference'
+           and r.get('row') == 'geister' and r.get('win_rate_vs_random_last5')]
+    if ref:
+        r = ref[-1]
+        p, n = r['win_rate_vs_random_last5'], r.get('eval_games', 0)
+        print('reference bar (torch, this host): %.3f +- %.3f '
+              '(%d games, %d epochs)\n' % (p, se(p, n), n, r['epochs']))
+
+    for label, row_name, curve_file in ARMS:
+        run = [r for r in bench if r.get('row') == row_name
+               and r.get('episodes')]
+        eps_per_epoch = (run[-1]['episodes'] / run[-1]['epochs']
+                         if run else float('nan'))
+        curve = [r for r in _rows(os.path.join(base, curve_file))
+                 if r.get('opponent', 'random') == 'random']
+        print('### %s  (%s, ~%.0f episodes/epoch)' %
+              (label, row_name, eps_per_epoch))
+        if not curve:
+            print('  (no rescore rows yet)\n')
+            continue
+        print('| epoch | ~episodes | win rate vs random | SE | games |')
+        print('|---|---|---|---|---|')
+        for r in curve:
+            n = r.get('games', 0)
+            print('| %d | %.0f | %.3f | +-%.3f | %d |' %
+                  (r['epoch'], r['epoch'] * eps_per_epoch,
+                   r['win_rate'], se(r['win_rate'], n), n))
+        print()
+
+
+if __name__ == '__main__':
+    main()
