@@ -20,9 +20,16 @@ all_done() {
   return 0
 }
 
+STOP_AT=${STOP_AT:-17:40}
 for try in $(seq 1 "$MAX_TRIES"); do
   if all_done; then
     echo "$(date +%H:%M:%S) supervisor: all items done" >> "$LOG_DIR/queue.log"
+    exit 0
+  fi
+  # never contend with the driver's round-end bench for the exclusive
+  # tunnel grant: stop opening windows near the round boundary
+  if [ "$(date +%s)" -gt "$(date -d "$STOP_AT" +%s)" ]; then
+    echo "$(date +%H:%M:%S) supervisor: past $STOP_AT, standing down" >> "$LOG_DIR/queue.log"
     exit 0
   fi
   bash scripts/tpu_probe_loop.sh "$PROBE_LOG" 300 || exit 1
