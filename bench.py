@@ -37,6 +37,15 @@ _CHILDREN = []
 METRIC = 'learner trajectories/sec (GeeseNet B=128 T=16, full update step)'
 UNIT = 'trajectories/sec'
 
+# BENCH_MODE=ingest measures the HOST side of the distributed learner path
+# instead: batches/sec from buffered episodes through the Batcher
+# (select -> bz2 decode -> arena assembly) to a staged, transfer-complete
+# device buffer. vs_baseline divides by the SAME pipeline running the
+# pre-vectorization reference builder (ops/batch.py make_batch_reference).
+INGEST_METRIC = ('host ingest batches/sec (GeeseNet B=128 T=16, '
+                 'Batcher -> staged device buffer)')
+INGEST_UNIT = 'batches/sec'
+
 # Per-chip peaks by device_kind substring: (key, bf16 FLOP/s, HBM bytes/s).
 # Public figures: v4 275T & 1.23TB/s, v5e 197T & 819GB/s, v5p 459T &
 # 2.77TB/s, v6e 918T & 1.64TB/s.
@@ -59,13 +68,19 @@ def _peak(device_kind: str, column: int) -> float:
     return 0.0
 
 
+def _active_mode() -> str:
+    return os.environ.get('BENCH_MODE', 'headline').strip().lower()
+
+
 def emit(value=0.0, vs_baseline=0.0, **extra):
     """Print the one JSON result line (at most once) and flush."""
     global _EMITTED
     if _EMITTED:
         return
     _EMITTED = True
-    line = {'metric': METRIC, 'value': round(float(value), 2), 'unit': UNIT,
+    metric, unit = ((INGEST_METRIC, INGEST_UNIT)
+                    if _active_mode() == 'ingest' else (METRIC, UNIT))
+    line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
             'vs_baseline': round(float(vs_baseline), 2)}
     line.update(extra)
     print(json.dumps(line), flush=True)
@@ -290,6 +305,125 @@ def run_bench(probe: dict):
          mfu=round(mfu, 4), mbu=round(mbu, 4), roofline_bound=bound)
 
 
+def _synthetic_geese_episodes(n_eps, rng, compress_steps=4, num_players=4,
+                              min_steps=24, max_steps=96):
+    """Buffered-episode stand-ins at the HungryGeese record geometry:
+    (17, 7, 11) float32 observation planes per player per ply, 4 actions,
+    all seats acting every ply (simultaneous env, solo-training config).
+    Planes are sparse binary like real goose boards, so bz2 block sizes —
+    and therefore the decode stage this benchmark times — are realistic
+    rather than incompressible white noise."""
+    from handyrl_tpu.ops.batch import compress_moments
+    import numpy as np
+
+    players = list(range(num_players))
+    eps = []
+    for _ in range(n_eps):
+        steps = int(rng.randint(min_steps, max_steps + 1))
+        moments = []
+        for _t in range(steps):
+            moments.append({
+                'observation': {p: (rng.rand(17, 7, 11) < 0.08)
+                                .astype(np.float32) for p in players},
+                'selected_prob': {p: float(rng.rand()) for p in players},
+                'action_mask': {p: np.zeros(4, np.float32) for p in players},
+                'action': {p: int(rng.randint(4)) for p in players},
+                'value': {p: np.array([float(rng.rand())], np.float32)
+                          for p in players},
+                'reward': {p: 0.0 for p in players},
+                'return': {p: float(rng.rand()) - 0.5 for p in players},
+                'turn': players,
+            })
+        eps.append({'args': {'player': players}, 'steps': steps,
+                    'outcome': {p: float(np.sign(rng.randn()))
+                                for p in players},
+                    'moment': compress_moments(moments, compress_steps)})
+    return eps
+
+
+def _measure_ingest(build_fn, episodes, args, n_batches, timer=None):
+    """batches/sec through Batcher -> device_put -> transfer complete,
+    using the REAL Batcher machinery (same queues, threads, staging)."""
+    import jax
+    import jax.numpy as jnp
+    from collections import deque
+    from handyrl_tpu.train import Batcher
+
+    batcher = Batcher(args, deque(episodes), timer=timer, build_fn=build_fn)
+    batcher.run()
+
+    def stage_one():
+        nxt = batcher.batch(timeout=60)
+        dev = jax.tree_util.tree_map(jnp.asarray, nxt)
+        jax.block_until_ready(dev)
+        return dev
+
+    stage_one()                      # warmup: thread spin-up, allocators
+    t0 = time.time()
+    for _ in range(n_batches):
+        nxt = batcher.batch(timeout=60)
+        th = time.time()
+        dev = jax.tree_util.tree_map(jnp.asarray, nxt)
+        jax.block_until_ready(dev)
+        if timer is not None:
+            timer.add('h2d', time.time() - th)
+    dt = time.time() - t0
+    batcher.stop()
+    return n_batches / max(dt, 1e-9)
+
+
+def run_ingest(probe: dict):
+    """BENCH_MODE=ingest: the host ingest path, CPU-measurable.
+
+    Env knobs (CI smoke shrinks them): BENCH_INGEST_BATCHES (timed batches,
+    default 20), BENCH_INGEST_EPISODES (buffer size, default 32),
+    BENCH_INGEST_BATCH_SIZE (default 128), BENCH_INGEST_BATCHERS
+    (num_batchers, default 2).
+    """
+    import numpy as np
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
+    from handyrl_tpu.ops.batch import make_batch, make_batch_reference
+    from handyrl_tpu.utils.timing import StageTimer
+
+    B = int(os.environ.get('BENCH_INGEST_BATCH_SIZE', '128'))
+    T = 16
+    n_batches = int(os.environ.get('BENCH_INGEST_BATCHES', '20'))
+    n_eps = int(os.environ.get('BENCH_INGEST_EPISODES', '32'))
+    args = {
+        # the north-star geese training geometry (scripts/run_north_star.py)
+        'turn_based_training': False, 'observation': True,
+        'forward_steps': T, 'burn_in_steps': 0, 'compress_steps': 4,
+        'maximum_episodes': 100000, 'batch_size': B,
+        'num_batchers': int(os.environ.get('BENCH_INGEST_BATCHERS', '2')),
+    }
+    rng = np.random.RandomState(7)
+    episodes = _synthetic_geese_episodes(n_eps, rng)
+
+    ref_fn = (lambda sel, a, timer=None, cache=None:  # noqa: E731
+              make_batch_reference(sel, a))
+    timer = StageTimer()
+    import contextlib
+    with contextlib.redirect_stdout(sys.stderr):
+        # batcher-thread startup prints must not break the one-JSON-line
+        # stdout contract
+        ref_bps = _measure_ingest(ref_fn, episodes, args, n_batches)
+        new_bps = _measure_ingest(make_batch, episodes, args, n_batches,
+                                  timer=timer)
+
+    default_geom = (B == 128 and T == 16)
+    emit(new_bps, (new_bps / ref_bps) if ref_bps else 0.0,
+         backend=probe.get('backend', 'unknown'),
+         device=probe.get('device_kind', 'unknown'),
+         batch_size=B, forward_steps=T, episodes=n_eps,
+         timed_batches=n_batches,
+         reference_batches_per_sec=round(ref_bps, 2),
+         vs_baseline_def=('arena builder / reference builder, identical '
+                          'Batcher machinery'),
+         stages=timer.snapshot(),
+         geometry=('headline' if default_geom else 'dryrun'))
+
+
 def _last_measured() -> str:
     """The newest on-silicon bench-headline row, summarized for the
     backend-unavailable JSON line — so a wedged tunnel at the driver's
@@ -331,7 +465,10 @@ def main():
                   '%s (benchmarks.jsonl bench-headline rows)' % (last,))
         return
     try:
-        run_bench(probe)
+        if _active_mode() == 'ingest':
+            run_ingest(probe)
+        else:
+            run_bench(probe)
     except Exception as exc:  # noqa: BLE001 — the contract is: always emit
         emit(error='%s: %s' % (type(exc).__name__, str(exc)[:200]),
              device=probe.get('device_kind', 'unknown'))

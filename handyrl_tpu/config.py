@@ -56,6 +56,9 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id
 
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
+    'decode_cache_blocks': 1024,  # LRU capacity (bz2 blocks) of the batchers' decoded-moment cache; recency-biased selection re-decodes the same blocks every batch without it. 0 disables; memory cost ~= blocks * compress_steps * per-moment bytes
+    'batcher_shared_memory': False,  # with batcher_processes: children assemble batches in shared-memory arenas and the trainer maps them zero-copy (no pickle over the pipe); slots recycle after the staged device upload completes
+    'prefetch_depth': 2,          # device staging ring depth: batches held as in-flight host->device uploads ahead of the compiled update step (1 = single-slot overlap, the pre-ring behavior)
     'compute_dtype': '',          # '' = float32; 'bfloat16' for MXU-friendly activations
     'profile_dir': '',            # when set, capture a jax profiler trace early in training
 }
@@ -108,4 +111,11 @@ def validate(args: Dict[str, Any]) -> None:
     if ta.get('max_sample_reuse') is not None:
         assert float(ta['max_sample_reuse']) > 0, \
             'max_sample_reuse must be > 0 (unset it to free-spin)'
+    if ta.get('prefetch_depth') is not None:
+        assert int(ta['prefetch_depth']) >= 1, \
+            'prefetch_depth must be >= 1 (or null for the default)'
+    if ta.get('batcher_shared_memory'):
+        assert ta.get('batcher_processes'), \
+            'batcher_shared_memory requires batcher_processes (the thread ' \
+            'batcher already shares the trainer address space)'
     assert 'env' in args['env_args'], 'env_args.env is required'

@@ -466,6 +466,10 @@ class JobPool:
         self.results: queue.Queue = queue.Queue(maxsize=results_max)
         self._endpoints = spawn_pipe_workers(
             num_workers, worker_fn, lambda i, c: (c, i), daemon=True)
+        # mp.Connection.send is not thread-safe: the dispatcher thread and
+        # out-of-band senders (send_to, e.g. shared-memory slot releases
+        # from the trainer thread) serialize per endpoint
+        self._send_locks = [threading.Lock() for _ in self._endpoints]
 
     # Batcher compatibility: the learner reads .output_queue
     @property
@@ -478,20 +482,31 @@ class JobPool:
     def recv(self):
         return self.results.get()
 
+    def send_to(self, idx: int, msg):
+        """Out-of-band message to worker ``idx`` (any thread); best-effort —
+        a dead worker's pipe error is swallowed like a dead socket's."""
+        try:
+            with self._send_locks[idx]:
+                self._endpoints[idx].send(msg)
+        except (OSError, ValueError, BrokenPipeError):
+            pass
+
     def _dispatch(self):
         import multiprocessing.connection as mpc
-        for ep in self._endpoints:
-            ep.send(next(self._jobs))
-        live = {ep.conn: ep for ep in self._endpoints}
+        for i, ep in enumerate(self._endpoints):
+            with self._send_locks[i]:
+                ep.send(next(self._jobs))
+        live = {ep.conn: (i, ep) for i, ep in enumerate(self._endpoints)}
         while live:
             for conn in mpc.wait(list(live)):
-                ep = live[conn]
+                i, ep = live[conn]
                 try:
                     result = ep.recv()
                 except (EOFError, OSError):
                     del live[conn]
                     continue
-                ep.send(next(self._jobs))     # refill before the maybe-block
+                with self._send_locks[i]:     # refill before the maybe-block
+                    ep.send(next(self._jobs))
                 if self._transform is not None:
                     result = self._transform(result)
                 self.results.put(result)

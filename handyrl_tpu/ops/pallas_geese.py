@@ -182,11 +182,12 @@ def _trunk_bwd(groups, tile, interpret, res, dy):
     # residual activation per conv/norm/relu for every layer. A (64, 7,
     # 11, 32) bf16 tile pads to (64, 7, 16, 128) on TPU (~1.8 MB), so 13
     # layers of residuals at the fwd tile would blow the ~16 MB VMEM.
-    # Run bwd at a smaller tile; grid steps are sequential, so this only
-    # trades dispatch count, not correctness (parity tests cover both).
-    small = min(tile, 8)
-    if N % small == 0:
-        tile = small
+    # Run bwd at the LARGEST divisor of N that is <= 8 (1 always divides,
+    # so every N degrades gracefully instead of silently keeping the full
+    # forward tile and blowing the VMEM budget at compile time); grid
+    # steps are sequential, so this only trades dispatch count, not
+    # correctness (parity tests cover both).
+    tile = max(d for d in range(1, min(tile, 8) + 1) if N % d == 0)
     F = stem_w.shape[-1]
     weights = (stem_w, stem_scale, stem_bias, block_w, block_scale,
                block_bias)

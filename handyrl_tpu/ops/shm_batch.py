@@ -1,0 +1,188 @@
+"""Shared-memory batch arenas: zero-copy batcher-process IPC.
+
+The process-mode Batcher (train.py, ``batcher_processes: True``) originally
+returned every finished ``(B, T, P, ...)`` batch over an ``mp.Pipe`` — a
+full pickle + copy on the child side and another deserialize + copy on the
+trainer side, per batch (~12 MB at the GeeseNet headline geometry). With
+``batcher_shared_memory: True`` each child instead owns a small ring of
+``multiprocessing.shared_memory`` arenas, builds batches IN PLACE with
+``make_batch(..., out=arena_views)``, and sends only a tiny slot descriptor
+over the pipe; the trainer maps the same pages once per slot and hands the
+numpy views straight to ``jax.device_put``. The only copy left on the whole
+host path is the H2D DMA itself.
+
+Layout: one SharedMemory segment per slot, leaves packed at 64-byte-aligned
+offsets in spec order. The spec (leaf paths, shapes, dtypes, offsets) is
+derived from the first batch the child builds and shipped once inside the
+first descriptor; geometry is fixed for a run, so every later descriptor is
+just ``(slot,)``.
+
+Flow control: a child marks a slot busy when it sends the descriptor and
+reuses it only after the trainer's ``('free', slot)`` message comes back
+(sent after the staged device transfer completes), so at most ``slots``
+batches per child are ever in flight — backpressure, not corruption, when
+the trainer falls behind.
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_ALIGN = 64   # leaf offsets cache-line aligned (also keeps dtypes aligned)
+
+
+# ---------------------------------------------------------------------------
+# spec: serializable description of a batch's memory layout
+
+
+def _walk_leaves(prefix: Tuple, x, out: List[Tuple[Tuple, np.ndarray]]):
+    if isinstance(x, dict):
+        for k in x:
+            _walk_leaves(prefix + (k,), x[k], out)
+    elif isinstance(x, (list, tuple)):
+        for i, v in enumerate(x):
+            _walk_leaves(prefix + (i,), v, out)
+    else:
+        out.append((prefix, np.asarray(x)))
+
+
+def batch_spec(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """Describe ``batch``'s leaves as msgpack-able metadata + total bytes."""
+    leaves: List[Tuple[Tuple, np.ndarray]] = []
+    _walk_leaves((), batch, leaves)
+    entries = []
+    offset = 0
+    for path, arr in leaves:
+        offset = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+        entries.append({'path': list(path), 'shape': list(arr.shape),
+                        'dtype': arr.dtype.str, 'offset': offset})
+        offset += arr.nbytes
+    return {'entries': entries, 'nbytes': max(offset, 1)}
+
+
+def _set_path(root: Dict[str, Any], path: List, value):
+    """Insert ``value`` at ``path``, creating nested dicts/lists on the way.
+    Integer components denote list indices (filled in ascending order)."""
+    node = root
+    for key, nxt in zip(path[:-1], path[1:]):
+        container = [] if isinstance(nxt, int) else {}
+        if isinstance(node, list):
+            if key == len(node):
+                node.append(container)
+            node = node[key]
+        else:
+            node = node.setdefault(key, container)
+    last = path[-1]
+    if isinstance(node, list):
+        assert last == len(node), (last, len(node))
+        node.append(value)
+    else:
+        node[last] = value
+
+
+def map_batch(buf, spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebuild the batch structure as numpy views over ``buf`` (zero-copy)."""
+    root: Dict[str, Any] = {}
+    for e in spec['entries']:
+        arr = np.ndarray(tuple(e['shape']), dtype=np.dtype(e['dtype']),
+                         buffer=buf, offset=e['offset'])
+        _set_path(root, list(e['path']), arr)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# child side
+
+
+class ArenaRing:
+    """A batcher child's ring of shared-memory batch slots."""
+
+    def __init__(self, spec: Dict[str, Any], slots: int = 4):
+        self.spec = spec
+        self.shms = [shared_memory.SharedMemory(create=True,
+                                                size=spec['nbytes'])
+                     for _ in range(slots)]
+        self.views = [map_batch(shm.buf, spec) for shm in self.shms]
+        self.free: List[int] = list(range(slots))
+
+    @property
+    def names(self) -> List[str]:
+        return [shm.name for shm in self.shms]
+
+    def acquire(self) -> Optional[int]:
+        return self.free.pop(0) if self.free else None
+
+    def release(self, slot: int):
+        self.free.append(slot)
+
+    def close(self):
+        for shm in self.shms:
+            try:
+                shm.close()
+                shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+
+
+def copy_into(views: Dict[str, Any], batch: Dict[str, Any]):
+    """Leaf-wise copy of ``batch`` into mapped arena ``views`` (used once,
+    for the first batch that had to be built before the spec existed)."""
+    leaves: List[Tuple[Tuple, np.ndarray]] = []
+    _walk_leaves((), batch, leaves)
+    dst: List[Tuple[Tuple, np.ndarray]] = []
+    _walk_leaves((), views, dst)
+    for (ps, src), (pd, d) in zip(leaves, dst):
+        assert ps == pd, (ps, pd)
+        np.copyto(d, src)
+
+
+# ---------------------------------------------------------------------------
+# trainer side
+
+
+class ArenaMap:
+    """The trainer's lazily-attached view of every child's slot segments."""
+
+    def __init__(self):
+        self._segs: Dict[str, shared_memory.SharedMemory] = {}
+        self._views: Dict[str, Dict[str, Any]] = {}
+
+    def attach(self, name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+        if name not in self._views:
+            seg = shared_memory.SharedMemory(name=name)
+            self._segs[name] = seg
+            self._views[name] = map_batch(seg.buf, spec)
+        return self._views[name]
+
+    def close(self):
+        self._views.clear()
+        for seg in self._segs.values():
+            try:
+                seg.close()
+            except OSError:
+                pass
+        self._segs.clear()
+
+
+class SharedBatch:
+    """A mapped batch plus the callback releasing its slot to the child.
+
+    The consumer MUST call :meth:`release` (exactly once) after the data has
+    been fully read (for the trainer: after the staged device transfer is
+    ready) — the child blocks on slot exhaustion, it never overwrites a
+    slot that has not been freed.
+    """
+
+    __slots__ = ('batch', '_release')
+
+    def __init__(self, batch: Dict[str, Any], release_fn):
+        self.batch = batch
+        self._release = release_fn
+
+    def release(self):
+        fn, self._release = self._release, None
+        if fn is not None:
+            fn()

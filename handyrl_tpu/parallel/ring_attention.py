@@ -20,8 +20,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from functools import partial
+
 from jax import lax
-from jax import shard_map
+try:
+    # jax >= 0.8: jax.shard_map, replication check named check_vma
+    shard_map = partial(jax.shard_map, check_vma=False)
+except AttributeError:
+    from jax.experimental.shard_map import shard_map
+    shard_map = partial(shard_map, check_rep=False)
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -76,7 +83,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     spec = P(None, axis, None, None)
     return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+                     out_specs=spec)(q, k, v)
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -59,10 +59,83 @@ def _batcher_process(conn, bid: int):
     """Child-process batch builder (config: batcher_processes=True)."""
     from .connection import force_cpu_backend
     force_cpu_backend()
+    from .ops.batch import make_block_cache
     print('started batcher process %d' % bid)
+    cache, have_cache = None, False
     while True:
         selected, args = conn.recv()
-        conn.send(make_batch(selected, args))
+        if not have_cache:
+            cache, have_cache = make_block_cache(args), True
+        conn.send(make_batch(selected, args, cache=cache))
+
+
+_SHM_SLOTS = 4   # in-flight shared-memory batches per batcher child
+
+
+def _is_free_msg(msg) -> bool:
+    return (isinstance(msg, tuple) and len(msg) == 2
+            and msg[0] == '__free__')
+
+
+def _batcher_process_shm(conn, bid: int):
+    """Child-process batch builder writing into shared-memory arenas
+    (config: batcher_processes + batcher_shared_memory).
+
+    Batches are assembled IN PLACE in a small ring of SharedMemory slots;
+    only a slot descriptor crosses the pipe — no pickle, no copy. The first
+    batch bootstraps the layout: it is built host-side, sized into the ring
+    (spec + segment names ride along in its descriptor), and copied in
+    once. A slot is reused only after the trainer's ``('__free__', slot)``
+    message confirms the staged device transfer read it.
+    """
+    from .connection import force_cpu_backend
+    force_cpu_backend()
+    from .ops.shm_batch import ArenaRing, batch_spec, copy_into
+    from .utils.timing import StageTimer
+    print('started shm batcher process %d' % bid)
+    from .ops.batch import make_block_cache
+    ring = None
+    timer = StageTimer()
+    cache, have_cache = None, False
+
+    def recv_job():
+        while True:
+            msg = conn.recv()
+            if _is_free_msg(msg):
+                ring.release(msg[1])
+                continue
+            return msg
+
+    def acquire_slot():
+        slot = ring.acquire()
+        while slot is None:   # all slots in flight: block on a free message
+            msg = conn.recv()
+            if not _is_free_msg(msg):
+                raise RuntimeError('expected a slot-free message, got %r'
+                                   % (msg,))
+            ring.release(msg[1])
+            slot = ring.acquire()
+        return slot
+
+    while True:
+        selected, args = recv_job()
+        desc = {'bid': bid}
+        if not have_cache:
+            cache, have_cache = make_block_cache(args), True
+        if ring is None:
+            batch = make_batch(selected, args, timer=timer, cache=cache)
+            ring = ArenaRing(batch_spec(batch), slots=_SHM_SLOTS)
+            slot = ring.acquire()
+            copy_into(ring.views[slot], batch)
+            desc['spec'] = ring.spec
+            desc['names'] = ring.names
+        else:
+            slot = acquire_slot()
+            make_batch(selected, args, out=ring.views[slot], timer=timer,
+                       cache=cache)
+        desc['slot'] = slot
+        desc['timing'] = timer.snapshot(reset=True)
+        conn.send(desc)
 
 
 class Batcher:
@@ -72,30 +145,53 @@ class Batcher:
     parts). With ``batcher_processes: True``, window selection stays in the
     learner process and make_batch fans out to spawned CPU processes via
     JobPool — the reference's num_batchers subprocess layout
-    (train.py:270-318)."""
+    (train.py:270-318). ``batcher_shared_memory: True`` additionally swaps
+    the pickled batch-over-pipe return for shared-memory arenas the
+    children fill in place (ops/shm_batch.py): ``batch()`` then yields
+    ``SharedBatch`` wrappers whose ``release()`` hands the slot back.
 
-    def __init__(self, args: Dict[str, Any], episodes: deque):
+    ``timer`` (utils.timing.StageTimer) aggregates the select/decode/
+    assemble stage breakdown across all batcher threads/processes;
+    ``build_fn`` swaps the batch builder (bench.py's ingest benchmark pins
+    the reference builder as its denominator through the SAME machinery).
+    """
+
+    def __init__(self, args: Dict[str, Any], episodes: deque,
+                 timer=None, build_fn=None):
         self.args = args
         self.episodes = episodes
+        self.timer = timer
+        self.build_fn = build_fn or make_batch
+        # decoded-block LRU shared by every batcher THREAD (each spawned
+        # process keeps its own); recency-biased selection re-reads the
+        # same episodes constantly, so steady-state decode cost ~vanishes
+        from .ops.batch import make_block_cache
+        self.cache = make_block_cache(args)
         self.output_queue: queue.Queue = queue.Queue(maxsize=8)
         self._started = False
         self.stop_flag = False
         self._threads: List[threading.Thread] = []
         self._executor = None
+        self._arena_map = None
+        self._shm_layouts: Dict[int, tuple] = {}
 
     def _selector(self):
         while True:
+            t0 = time.perf_counter()
             try:
                 selected = [select_episode(self.episodes, self.args)
                             for _ in range(self.args['batch_size'])]
             except (IndexError, ValueError):   # buffer transiently empty
                 time.sleep(0.1)
                 continue
+            if self.timer is not None:
+                self.timer.add('select', time.perf_counter() - t0)
             # strip non-picklable/irrelevant entries from the job payload
             job_args = {k: v for k, v in self.args.items()
                         if k in ('turn_based_training', 'observation',
                                  'forward_steps', 'burn_in_steps',
-                                 'compress_steps', 'maximum_episodes')}
+                                 'compress_steps', 'maximum_episodes',
+                                 'decode_cache_blocks')}
             yield (selected, job_args)
 
     def run(self):
@@ -104,9 +200,16 @@ class Batcher:
         self._started = True
         if self.args.get('batcher_processes'):
             from .connection import JobPool
-            self._executor = JobPool(
-                _batcher_process, self._selector(),
-                self.args['num_batchers'])
+            if self.args.get('batcher_shared_memory'):
+                from .ops.shm_batch import ArenaMap
+                self._arena_map = ArenaMap()
+                self._executor = JobPool(
+                    _batcher_process_shm, self._selector(),
+                    self.args['num_batchers'], transform=self._map_shm)
+            else:
+                self._executor = JobPool(
+                    _batcher_process, self._selector(),
+                    self.args['num_batchers'])
             self._executor.start()
             return
         for i in range(self.args['num_batchers']):
@@ -114,13 +217,33 @@ class Batcher:
             t.start()
             self._threads.append(t)
 
+    def _map_shm(self, desc):
+        """Turn a child's slot descriptor into a zero-copy SharedBatch
+        (runs in the JobPool dispatcher thread)."""
+        from .ops.shm_batch import SharedBatch
+        bid = desc['bid']
+        if 'spec' in desc:
+            self._shm_layouts[bid] = (desc['spec'], desc['names'])
+        spec, names = self._shm_layouts[bid]
+        views = self._arena_map.attach(names[desc['slot']], spec)
+        if self.timer is not None and desc.get('timing'):
+            for stage, row in desc['timing'].items():
+                self.timer.add(stage, row['s'], int(row['n']))
+        pool, slot = self._executor, desc['slot']
+        return SharedBatch(views,
+                           lambda: pool.send_to(bid, ('__free__', slot)))
+
     def _worker(self, bid: int):
         print('started batcher %d' % bid)
         while not self.stop_flag:
             try:
+                t0 = time.perf_counter()
                 selected = [select_episode(self.episodes, self.args)
                             for _ in range(self.args['batch_size'])]
-                batch = make_batch(selected, self.args)
+                if self.timer is not None:
+                    self.timer.add('select', time.perf_counter() - t0)
+                batch = self.build_fn(selected, self.args, timer=self.timer,
+                                      cache=self.cache)
             except (IndexError, ValueError):
                 time.sleep(0.1)
                 continue
@@ -140,6 +263,13 @@ class Batcher:
         self.stop_flag = True
         for t in self._threads:
             t.join(timeout=5)
+        # NOTE: the shared-memory mappings (_arena_map) are deliberately NOT
+        # closed here — the trainer thread may still be staging a mapped
+        # batch (device_put reads the pages) when shutdown begins, and
+        # unmapping under it is a segfault. The set of segments is small
+        # and fixed (num_batchers x _SHM_SLOTS); the OS reclaims them at
+        # process exit, and the children's resource trackers unlink the
+        # names when the (daemon) children die with us.
 
 
 class Trainer:
@@ -174,7 +304,17 @@ class Trainer:
         self.default_lr = 3e-8
         self.data_cnt_ema = args['batch_size'] * args['forward_steps']
         self.steps = 0
-        self.batcher = Batcher(args, self.episodes)
+        # per-stage ingest-path accounting (select/decode/assemble/ipc/h2d/
+        # compute/drain), shared by the batcher threads/processes and the
+        # trainer loop; printed per epoch under HANDYRL_TPU_TIMING=1 and
+        # reported by bench.py's BENCH_MODE=ingest
+        from .utils.timing import StageTimer
+        self.ingest_timer = StageTimer()
+        self.batcher = Batcher(args, self.episodes, timer=self.ingest_timer)
+        # depth of the device staging ring: how many batches are held as
+        # in-flight device uploads ahead of the compiled step (config
+        # 'prefetch_depth'; 1 = the old single-slot overlap)
+        self.prefetch_depth = max(1, int(args.get('prefetch_depth') or 1))
 
         # optional HBM-resident replay: new episodes are windowed once on
         # the host and pushed to a device ring; every SGD step then samples
@@ -305,16 +445,46 @@ class Trainer:
         else:
             profile_stop_at = -1
 
-        staged = None   # one-slot H2D prefetch: upload batch t+1 while t runs
+        # device staging ring: up to ``prefetch_depth`` batches held as
+        # in-flight device uploads ahead of the compiled step (the old code
+        # was the depth-1 special case). Persisted on the instance so
+        # batches staged across an epoch boundary are consumed, not dropped.
+        if not hasattr(self, '_staged'):
+            self._staged = deque()
+        staged = self._staged
+        timer = self.ingest_timer
 
         def stage_next():
+            t0 = time.perf_counter()
             try:
                 nxt = self.batcher.batch(timeout=1.0)
             except queue.Empty:
+                timer.add('ipc', time.perf_counter() - t0)
                 return None
+            timer.add('ipc', time.perf_counter() - t0)
+            release = None
+            if hasattr(nxt, 'release'):      # shared-memory slot wrapper
+                nxt, release = nxt.batch, nxt.release
+            t0 = time.perf_counter()
             if self.mesh is not None:
-                return shard_batch(self.mesh, nxt)
-            return jax.tree_util.tree_map(jnp.asarray, nxt)
+                dev = shard_batch(self.mesh, nxt)
+            else:
+                dev = jax.tree_util.tree_map(jnp.asarray, nxt)
+            if release is not None:
+                # the batcher child may reuse the slot only once the upload
+                # has read the shared pages (device_put copies; this waits
+                # for that copy, never for compute)
+                jax.block_until_ready(dev)
+                release()
+            timer.add('h2d', time.perf_counter() - t0)
+            return dev
+
+        def top_up():
+            while len(staged) < self.prefetch_depth:
+                nxt = stage_next()
+                if nxt is None:
+                    break
+                staged.append(nxt)
 
         while (data_cnt == 0 or not self.update_flag) and not self.shutdown_flag:
             if self.replay is not None:
@@ -372,20 +542,26 @@ class Trainer:
                     profile_stop_at = -1
                     print('profiler trace written to %s' % self._profile_dir)
                 continue
-            batch = staged if staged is not None else stage_next()
-            staged = None
-            if batch is None:
-                continue
+            if not staged:
+                top_up()
+                if not staged:
+                    continue
+            batch = staged.popleft()
             lr = jnp.asarray(self._lr(), jnp.float32)
+            t_dispatch = time.perf_counter()
             self.state, metrics = self.update_step(self.state, batch, lr)
-            # device_put of the next batch overlaps with the running step
-            staged = stage_next()
+            timer.add('compute', time.perf_counter() - t_dispatch)
+            # the ring refills (device_put of the next batches) while the
+            # dispatched step runs on device
+            top_up()
             pending_metrics.append(metrics)
             batch_cnt += 1
             # data_count is a device scalar; fetch lazily every few steps to
             # avoid a sync per update
             if len(pending_metrics) >= 8:
+                t_drain = time.perf_counter()
                 data_cnt += self._drain_metrics(pending_metrics)
+                timer.add('drain', time.perf_counter() - t_drain)
                 pending_metrics = []
             self.steps += 1
             if self.steps == profile_stop_at:
@@ -405,6 +581,11 @@ class Trainer:
             self.data_cnt_ema = (self.data_cnt_ema * 0.8
                                  + data_cnt / (1e-2 + batch_cnt) * 0.2)
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
+            if os.environ.get('HANDYRL_TPU_TIMING') == '1':
+                # one line per epoch: seconds + event counts per ingest
+                # stage ('compute' is dispatch time; 'drain' is the sync)
+                print('ingest timing: %s' % json.dumps(
+                    self.ingest_timer.snapshot(reset=True)))
         from .utils.fetch import fetch_tree
         return fetch_tree(self.state.params)
 

@@ -1,0 +1,78 @@
+"""Per-stage wall-clock accounting for the host ingest path.
+
+The distributed learner path crosses several hand-off points (episode
+selection -> bz2 decode -> batch assembly -> batcher IPC -> host-to-device
+staging -> compiled update -> metric drain), and a regression in any one of
+them hides inside an aggregate episodes/sec number. ``StageTimer``
+accumulates wall seconds and event counts per named stage from any thread
+(batcher threads and the trainer thread share one instance), and the
+``HANDYRL_TPU_TIMING=1`` hook prints one compact JSON line per epoch with
+the breakdown — the same stage names ``BENCH_MODE=ingest`` (bench.py)
+reports, so a bench row and a live-run epoch line are directly comparable.
+
+Canonical stage names for the ingest path:
+  select / decode / assemble / ipc / h2d / compute / drain
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+
+class StageTimer:
+    """Thread-safe accumulator of per-stage wall time.
+
+    ``add`` is cheap (one lock acquisition); the timed sections themselves
+    run unlocked, so batcher threads never serialize on the timer.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc: Dict[str, float] = {}
+        self._n: Dict[str, int] = {}
+
+    def add(self, stage: str, seconds: float, count: int = 1):
+        with self._lock:
+            self._acc[stage] = self._acc.get(stage, 0.0) + seconds
+            self._n[stage] = self._n.get(stage, 0) + count
+
+    @contextmanager
+    def section(self, stage: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(stage, time.perf_counter() - t0)
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Dict[str, float]]:
+        """{stage: {'s': total_seconds, 'n': events}} at this instant."""
+        with self._lock:
+            out = {k: {'s': round(self._acc[k], 4), 'n': self._n.get(k, 0)}
+                   for k in self._acc}
+            if reset:
+                self._acc.clear()
+                self._n.clear()
+        return out
+
+    def seconds(self, stage: str) -> float:
+        with self._lock:
+            return self._acc.get(stage, 0.0)
+
+
+def null_section(_stage):
+    """A no-op replacement for ``StageTimer.section`` when timing is off."""
+    return _NULL
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()

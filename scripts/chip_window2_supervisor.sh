@@ -20,7 +20,16 @@ all_done() {
   return 0
 }
 
-STOP_AT=${STOP_AT:-17:40}
+# Cutoffs are ABSOLUTE unix epochs computed ONCE at supervisor start
+# (START + duration). The previous `date -d 'HH:MM'` wall-clock anchors
+# re-resolved on every loop iteration, so a run crossing midnight saw
+# "past 17:40" immediately and stood the supervisor down hours early.
+START_TS=$(date +%s)
+STOP_AFTER_S=${STOP_AFTER_S:-21600}        # stand down N seconds after start
+STOP_AT_TS=${STOP_AT_TS:-$(( START_TS + STOP_AFTER_S ))}
+NS_TAIL_S=${NS_TAIL_S:-6000}               # reserve for the non-NS queue tail
+NS_CUTOFF_TS=$(( STOP_AT_TS - NS_TAIL_S ))
+echo "$(date +%H:%M:%S) supervisor: start $START_TS stop_at $STOP_AT_TS ns_cutoff $NS_CUTOFF_TS" >> "$LOG_DIR/queue.log"
 for try in $(seq 1 "$MAX_TRIES"); do
   if all_done; then
     echo "$(date +%H:%M:%S) supervisor: all items done" >> "$LOG_DIR/queue.log"
@@ -28,18 +37,18 @@ for try in $(seq 1 "$MAX_TRIES"); do
   fi
   # never contend with the driver's round-end bench for the exclusive
   # tunnel grant: stop opening windows near the round boundary
-  if [ "$(date +%s)" -gt "$(date -d "$STOP_AT" +%s)" ]; then
-    echo "$(date +%H:%M:%S) supervisor: past $STOP_AT, standing down" >> "$LOG_DIR/queue.log"
+  if [ "$(date +%s)" -gt "$STOP_AT_TS" ]; then
+    echo "$(date +%H:%M:%S) supervisor: past stop epoch $STOP_AT_TS, standing down" >> "$LOG_DIR/queue.log"
     exit 0
   fi
   bash scripts/tpu_probe_loop.sh "$PROBE_LOG" 300 || exit 1
   # North-star budget: whatever gets closest to the 1M-episode endpoint
   # (~16800 s at the measured 57.4 eps/s on top of the 60k in the bank)
   # without pushing the rest of the queue past the round's tail — cap
-  # at a 16:00 cutoff, floor at 30 min so a late window still extends
-  # the curve meaningfully.
-  now=$(date +%s); cutoff=$(date -d '16:00' +%s 2>/dev/null || echo "$now")
-  ns=$(( cutoff - now )); [ "$ns" -gt 16800 ] && ns=16800
+  # at the precomputed cutoff epoch, floor at 30 min so a late window
+  # still extends the curve meaningfully.
+  now=$(date +%s)
+  ns=$(( NS_CUTOFF_TS - now )); [ "$ns" -gt 16800 ] && ns=16800
   [ "$ns" -lt 1800 ] && ns=1800
   echo "$(date +%H:%M:%S) supervisor: window $try (NS_BUDGET_S=$ns)" >> "$LOG_DIR/queue.log"
   LOG_DIR="$LOG_DIR" NS_BUDGET_S="$ns" bash scripts/chip_window2.sh

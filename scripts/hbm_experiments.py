@@ -104,15 +104,30 @@ def variant(name, dtype=None, cast_state=False, torus_impl=None,
     if torus_impl is not None:
         # numerics probe of the REAL lowering (interpret mode and Mosaic
         # are different executors): forward the same params/obs through
-        # the wrap-pad twin and this impl before timing anything
+        # the wrap-pad twin and this impl before timing anything. The
+        # criterion is RELATIVE to the reference logit scale — a fixed
+        # 0.05 absolute band on bf16 logits silently loosens as the scale
+        # grows and a bad lowering could pass it while being wrong.
         obs = batch['observation'][:64, 0, 0]
         ref = module.clone(torus_impl='pad').apply(state.params, obs, None)
         got = module.apply(state.params, obs, None)
-        err = max(float(jnp.abs(jnp.asarray(ref[k], jnp.float32)
-                                - jnp.asarray(got[k], jnp.float32)).max())
-                  for k in ('policy', 'value'))
-        parity = {'max_abs_err_vs_pad': err, 'ok': bool(err < 0.05)}
+        err = scale = 0.0
+        for k in ('policy', 'value'):
+            rk = jnp.asarray(ref[k], jnp.float32)
+            gk = jnp.asarray(got[k], jnp.float32)
+            err = max(err, float(jnp.abs(rk - gk).max()))
+            scale = max(scale, float(jnp.abs(rk).max()))
+        rel = err / max(scale, 1e-6)
+        parity = {'max_abs_err_vs_pad': err, 'ref_scale': scale,
+                  'rel_err': rel, 'ok': bool(rel < 0.05)}
         print('parity[%s]: %s' % (tagged, parity), flush=True)
+        if not parity['ok']:
+            # a lowering that fails parity must never produce a
+            # fast-but-wrong headline candidate: skip the timed run and
+            # emit an explicitly invalid row instead
+            return {'row': 'hbm-experiment', 'variant': tagged,
+                    'invalid': True, 'parity': parity,
+                    'time': time.strftime('%Y-%m-%d %H:%M:%S')}
     if cast_state:
         # params AND Adam moments in bf16: halves the read+write traffic
         # of every weight and optimizer buffer

@@ -71,7 +71,7 @@ def main():
     from handyrl_tpu.config import apply_defaults
     from handyrl_tpu.train import Learner
 
-    epochs = 600
+    epochs = None   # None = not explicitly given (default 600, see below)
     host = False
     budget_s = None
     metrics_out = None
@@ -104,12 +104,12 @@ def main():
     raw['train_args']['model_dir'] = model_dir
     raw['train_args']['metrics_jsonl'] = (metrics_out or
                                           'north_star_%s.jsonl' % tag)
-    if budget_s is not None and epochs == 600:
-        # budget governs: the round-5 chip run stopped at the DEFAULT
-        # 600-epoch cap after 17 min of a 150-min budget. With an
-        # explicit --budget-s and no explicit --epochs, let the deadline
-        # be the only stop.
-        epochs = 10 ** 6
+    if epochs is None:
+        # budget governs when given: the round-5 chip run stopped at the
+        # DEFAULT 600-epoch cap after 17 min of a 150-min budget. Only an
+        # epoch cap the operator actually TYPED limits a budgeted run —
+        # `--epochs 600 --budget-s ...` really stops at 600 now.
+        epochs = 10 ** 6 if budget_s is not None else 600
     raw['train_args']['epochs'] = epochs
     start = latest_epoch(model_dir)
     raw['train_args']['restart_epoch'] = start

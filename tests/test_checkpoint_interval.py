@@ -3,15 +3,22 @@
 The fused device loop may skip the per-epoch host state fetch + ckpt write
 (train.py _fused_epoch); these pin the knob's contract: numbered ckpts at
 multiples of N plus the final epoch, trainer state resumable from them.
+
+The learner itself runs in a SPAWNED subprocess: this exact fused program
+has segfaulted XLA CPU on some hosts, and an in-process crash kills the
+whole pytest run (hiding every later test file) instead of failing one
+test. The subprocess boundary turns a backend crash into a plain failure
+with an exit code; on healthy hosts the contract is tested unchanged.
 """
 
 import glob
+import json
+import multiprocessing as mp
 import os
 
 import pytest
 
 from handyrl_tpu.config import apply_defaults
-from handyrl_tpu.train import Learner
 
 
 def _args(tmp, **over):
@@ -35,21 +42,59 @@ def _ckpt_numbers(model_dir):
                   if os.path.basename(p).split('.')[0].isdigit())
 
 
+def _learner_child(args, report_path):
+    # keep the child off the persistent XLA compile cache: jaxlib 0.4.x CPU
+    # corrupts the heap (malloc abort / SIGSEGV) deserializing the cached
+    # fused-pipeline executable on the resume run; these programs compile in
+    # seconds, so the child just recompiles
+    os.environ['HANDYRL_TPU_NO_COMPILE_CACHE'] = '1'
+    from handyrl_tpu.train import Learner
+    ln = Learner(args=args)
+    steps_at_start = ln.trainer.steps
+    ln.run()
+    with open(report_path, 'w') as f:
+        json.dump({'model_epoch': ln.model_epoch,
+                   'steps_at_start': steps_at_start}, f)
+
+
+def _run_learner(args, tmp, tag, timeout=480):
+    """Run the learner in a spawned child; return its exit report."""
+    report = os.path.join(tmp, 'report_%s.json' % tag)
+    ctx = mp.get_context('spawn')
+    proc = ctx.Process(target=_learner_child, args=(args, report))
+    proc.start()
+    proc.join(timeout=timeout)
+    if proc.is_alive():
+        proc.terminate()
+        proc.join(10)
+        pytest.fail('learner subprocess timed out (%s)' % tag)
+    # The report is written AFTER ln.run() returns, so its existence means
+    # the training contract completed; a nonzero exit with a report present
+    # is the known XLA daemon-thread abort at interpreter teardown
+    # (train.py Learner.shutdown docstring), not a training failure.
+    if not os.path.exists(report):
+        pytest.fail('learner subprocess died with exit code %s (%s) — '
+                    'backend crash, see stderr above' % (proc.exitcode, tag))
+    if proc.exitcode != 0:
+        print('note: learner child (%s) exited %s AFTER completing its run '
+              '(teardown abort)' % (tag, proc.exitcode))
+    with open(report) as f:
+        return json.load(f)
+
+
 @pytest.mark.timeout(560)
 def test_interval_cadence_and_final_flush(tmp_path):
     args = _args(str(tmp_path))
-    ln = Learner(args=args)
-    ln.run()
+    rep = _run_learner(args, str(tmp_path), 'first')
     model_dir = args['train_args']['model_dir']
     # multiples of 3 from the interval, 7 from the final-epoch force-write
     assert _ckpt_numbers(model_dir) == [3, 6, 7]
     assert os.path.exists(os.path.join(model_dir, 'trainer_state.ckpt'))
-    assert ln.model_epoch == 7
+    assert rep['model_epoch'] == 7
 
     # resume from the final flush: params + optimizer state round-trip
     args2 = _args(str(tmp_path), restart_epoch=7, epochs=8)
-    ln2 = Learner(args=args2)
-    assert ln2.trainer.steps > 0          # trainer state actually loaded
-    ln2.run()
-    assert ln2.model_epoch == 8
+    rep2 = _run_learner(args2, str(tmp_path), 'resume')
+    assert rep2['steps_at_start'] > 0     # trainer state actually loaded
+    assert rep2['model_epoch'] == 8
     assert 8 in _ckpt_numbers(model_dir)

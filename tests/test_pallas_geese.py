@@ -123,3 +123,23 @@ def test_bad_tile_rejected():
     obs, _, _, kp = _setup(N=6)
     with pytest.raises(AssertionError):
         trunk_apply(to_nhwc(obs), *kp, 8, 4, True)
+
+
+def test_trunk_bwd_non_divisible_batch():
+    """N with no divisor 8/tile in common (N=12, fwd tile=12): the bwd pass
+    must drop to the largest divisor of N <= 8 (here 6) instead of silently
+    keeping the full forward tile — gradients stay exact either way."""
+    obs, trunk, params, kp = _setup(N=12)
+
+    def loss_flax(p):
+        return (trunk.apply(p, obs) ** 2).mean()
+
+    def loss_kernel(kp_):
+        return (trunk_apply(to_nhwc(obs), *kp_, 8, 12, True) ** 2).mean()
+
+    g_ref = trunk_params_from_geesenet(jax.grad(loss_flax)(params),
+                                       layers=LAYERS)
+    g_got = jax.grad(loss_kernel)(kp)
+    for a, b in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
