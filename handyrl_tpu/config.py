@@ -55,6 +55,19 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'metrics_jsonl': '',          # optional structured metrics path
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id
 
+    # distributed-fleet fault tolerance (docs/large_scale_training.md):
+    # heartbeats, silent-peer detach, supervised reconnect, task re-issue
+    'fault_tolerance': {
+        'heartbeat_interval': 10.0,    # gather -> server liveness beacon period (s)
+        'liveness_timeout': 60.0,      # detach a silent socket peer after (s); must exceed heartbeat_interval
+        'rpc_timeout': 120.0,          # gather-side blocking RPC deadline (s); a dead server fails the call instead of hanging it
+        'task_deadline': 300.0,        # re-issue an assigned generation/eval task not returned within (s)
+        'reconnect_initial_delay': 1.0,  # first reconnect backoff step (s); doubles per failure, jittered
+        'reconnect_max_delay': 30.0,   # backoff ceiling (s)
+        'reconnect_max_tries': 30,     # redials before a gather gives up (and respawns before a gather slot is abandoned)
+        'resend_buffer': 256,          # max unacked uploads a gather retains across reconnects; older ones are dropped + counted
+    },
+
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
     'decode_cache_blocks': 1024,  # LRU capacity (bz2 blocks) of the batchers' decoded-moment cache; recency-biased selection re-decodes the same blocks every batch without it. 0 disables; memory cost ~= blocks * compress_steps * per-moment bytes
     'batcher_shared_memory': False,  # with batcher_processes: children assemble batches in shared-memory arenas and the trainer maps them zero-copy (no pickle over the pipe); slots recycle after the staged device upload completes
@@ -114,6 +127,18 @@ def validate(args: Dict[str, Any]) -> None:
     if ta.get('prefetch_depth') is not None:
         assert int(ta['prefetch_depth']) >= 1, \
             'prefetch_depth must be >= 1 (or null for the default)'
+    ft = ta.get('fault_tolerance') or {}
+    for key in ('heartbeat_interval', 'liveness_timeout', 'rpc_timeout',
+                'task_deadline', 'reconnect_initial_delay',
+                'reconnect_max_delay', 'reconnect_max_tries',
+                'resend_buffer'):
+        if ft.get(key) is not None:
+            assert float(ft[key]) > 0, \
+                'fault_tolerance.%s must be > 0' % key
+    if ft.get('liveness_timeout') and ft.get('heartbeat_interval'):
+        assert float(ft['liveness_timeout']) > float(ft['heartbeat_interval']), \
+            'liveness_timeout must exceed heartbeat_interval or every ' \
+            'healthy peer is detached between beacons'
     if ta.get('batcher_shared_memory'):
         assert ta.get('batcher_processes'), \
             'batcher_shared_memory requires batcher_processes (the thread ' \

@@ -132,6 +132,10 @@ class FramedConnection:
         self.sock: Optional[socket.socket] = sock
         self._parser = FrameParser()
         self._ready: deque = deque()
+        # serialize concurrent senders (e.g. a gather's main RPC loop and
+        # its heartbeat thread): interleaved sendall calls would splice two
+        # frames together and desync the stream
+        self._send_lock = threading.Lock()
 
     def fileno(self) -> int:
         return self.sock.fileno()
@@ -150,7 +154,8 @@ class FramedConnection:
         if len(payload) > MAX_FRAME_BYTES:
             raise ValueError('message of %d bytes exceeds the frame limit'
                              % len(payload))
-        self.sock.sendall(_HEADER.pack(len(payload)) + payload)
+        with self._send_lock:
+            self.sock.sendall(_HEADER.pack(len(payload)) + payload)
 
     @staticmethod
     def _decode(payload: bytes):
@@ -259,6 +264,34 @@ def accept_socket_connections(port: int, timeout: Optional[float] = None,
 
 _WRITER_EXIT = object()   # per-endpoint writer shutdown sentinel
 
+# Heartbeat frames are a one-way liveness beacon from blocking RPC clients
+# (gathers) to the Hub: the Hub refreshes the sender's liveness deadline,
+# records the payload (client-side fleet stats), and never replies — a
+# reply would land in the middle of the client's call-response stream.
+HEARTBEAT_KIND = '__hb__'
+
+
+def is_heartbeat(msg) -> bool:
+    return (isinstance(msg, (list, tuple)) and len(msg) == 2
+            and msg[0] == HEARTBEAT_KIND)
+
+
+def _describe(endpoint) -> str:
+    """Human identity of an endpoint for disconnect logs."""
+    sock = getattr(endpoint, 'sock', None)
+    if sock is not None:
+        try:
+            peer = sock.getpeername()
+        except OSError:
+            return 'socket peer (already closed)'
+        if isinstance(peer, tuple) and len(peer) >= 2:   # AF_INET[6]
+            return 'socket peer %s:%s' % peer[:2]
+        return 'socket peer %r' % (peer,)                # AF_UNIX et al.
+    try:
+        return 'pipe fd %d' % endpoint.fileno()
+    except Exception:
+        return 'endpoint'
+
 
 class Hub:
     """Message multiplexer: one selector read loop + one writer per endpoint.
@@ -271,16 +304,34 @@ class Hub:
     or its outbox backs up past ``OUTBOX_MAX`` queued messages. Endpoints
     may be attached / detached from any thread at any time (workers are
     elastic); a failed read or write detaches the endpoint.
+
+    Liveness: socket endpoints additionally carry a per-endpoint deadline —
+    a peer that sends NOTHING (not even a ``HEARTBEAT_KIND`` beacon) for
+    ``LIVENESS_TIMEOUT`` seconds is presumed silently dead (half-open TCP:
+    the remote host vanished without a FIN) and detached, instead of
+    holding its slot until some future write happens to fail. Any received
+    frame refreshes the deadline; heartbeat frames are filtered out of the
+    inbox and their payloads retained per endpoint (``peer_info_snapshot``).
+    Pipe endpoints are exempt — a dead pipe peer is always observable as an
+    immediate EOF. Every disconnect is counted by reason in ``stats`` and
+    journaled for ``drain_detach_events`` (the learner's task ledger feeds
+    on it).
     """
 
     SEND_TIMEOUT = 30.0
     OUTBOX_MAX = 512
+    LIVENESS_TIMEOUT = 60.0   # silent-socket-peer deadline; 0 disables
 
     def __init__(self, endpoints: Optional[List] = None, inbox_max: int = 256):
         self._inbox: queue.Queue = queue.Queue(maxsize=inbox_max)
         self._outboxes: Dict[Any, queue.Queue] = {}
         self._commands: deque = deque()
         self._lock = threading.Lock()
+        self._liveness: Dict[Any, float] = {}
+        self._last_recv: Dict[Any, float] = {}
+        self._peer_info: Dict[Any, Any] = {}
+        self._detach_events: deque = deque(maxlen=4096)
+        self.stats: Dict[str, int] = {}
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._selector = selectors.DefaultSelector()
@@ -298,6 +349,27 @@ class Hub:
     # QueueCommunicator-compatible alias used by the learner's server loop
     connection_count = count
 
+    def _bump(self, key: str, n: int = 1):
+        """Increment a stats counter (caller holds no lock)."""
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.stats)
+
+    def peer_info_snapshot(self) -> Dict[Any, Any]:
+        """Latest heartbeat payload per live endpoint."""
+        with self._lock:
+            return dict(self._peer_info)
+
+    def drain_detach_events(self) -> List[Tuple[Any, str, float]]:
+        """Consume the (endpoint, reason, time) disconnect journal."""
+        with self._lock:
+            events = list(self._detach_events)
+            self._detach_events.clear()
+        return events
+
     def recv(self, timeout: Optional[float] = None) -> Tuple[Any, Any]:
         return self._inbox.get(timeout=timeout)
 
@@ -309,18 +381,26 @@ class Hub:
         try:
             outbox.put_nowait(msg)
         except queue.Full:      # peer hopelessly behind — treat as stalled
-            self.detach(endpoint)
+            self.detach(endpoint, reason='outbox_overflow')
 
-    def attach(self, endpoint):
+    def attach(self, endpoint, liveness: Optional[float] = None):
+        """Register ``endpoint``. ``liveness`` overrides the silent-peer
+        deadline in seconds (0 disables); default: ``LIVENESS_TIMEOUT`` for
+        socket endpoints, disabled for pipes."""
         sock = getattr(endpoint, 'sock', None)
         if sock is not None:
             sock.settimeout(self.SEND_TIMEOUT)   # bound writer stalls
+        if liveness is None:
+            liveness = self.LIVENESS_TIMEOUT if sock is not None else 0.0
         outbox: queue.Queue = queue.Queue(maxsize=self.OUTBOX_MAX)
         with self._lock:
             if endpoint in self._outboxes:
                 return
             self._outboxes[endpoint] = outbox
+            self._liveness[endpoint] = float(liveness or 0.0)
+            self._last_recv[endpoint] = time.monotonic()
             self._commands.append(('+', endpoint))
+            self.stats['attached'] = self.stats.get('attached', 0) + 1
         threading.Thread(target=self._write_loop, args=(endpoint, outbox),
                          daemon=True).start()
         self._wake()
@@ -328,16 +408,25 @@ class Hub:
     # API name kept for operator familiarity with the reference logs
     add_connection = attach
 
-    def detach(self, endpoint):
-        print('disconnected')
+    def detach(self, endpoint, reason: str = 'requested'):
         with self._lock:
             outbox = self._outboxes.pop(endpoint, None)
-            self._commands.append(('-', endpoint))
-        if outbox is not None:
-            try:                          # fast writer wake; the writer also
-                outbox.put_nowait(_WRITER_EXIT)   # polls attachment, so a
-            except queue.Full:            # full outbox can't wedge detach
-                pass
+            if outbox is not None:
+                self._liveness.pop(endpoint, None)
+                self._last_recv.pop(endpoint, None)
+                self._peer_info.pop(endpoint, None)
+                self._commands.append(('-', endpoint))
+                self.stats['detached'] = self.stats.get('detached', 0) + 1
+                key = 'disconnect_' + reason
+                self.stats[key] = self.stats.get(key, 0) + 1
+                self._detach_events.append((endpoint, reason, time.time()))
+        if outbox is None:
+            return                        # already gone: count/log only once
+        print('disconnected %s (%s)' % (_describe(endpoint), reason))
+        try:                              # fast writer wake; the writer also
+            outbox.put_nowait(_WRITER_EXIT)   # polls attachment, so a
+        except queue.Full:                # full outbox can't wedge detach
+            pass
         self._wake()
 
     # -- loop internals --
@@ -377,9 +466,21 @@ class Hub:
                 return
             try:
                 ep.send(msg)
-            except (OSError, ValueError, TimeoutError, AttributeError):
-                self.detach(ep)   # AttributeError: closed while queued
+            except (OSError, ValueError, TimeoutError, AttributeError) as exc:
+                # AttributeError: closed while queued
+                reason = ('send_timeout'
+                          if isinstance(exc, (socket.timeout, TimeoutError))
+                          else 'send_error')
+                self.detach(ep, reason=reason)
                 return
+
+    def _check_liveness(self):
+        now = time.monotonic()
+        with self._lock:
+            stale = [ep for ep, limit in self._liveness.items()
+                     if limit > 0 and now - self._last_recv.get(ep, now) > limit]
+        for ep in stale:
+            self.detach(ep, reason='heartbeat_miss')
 
     def _read_loop(self):
         while True:
@@ -395,11 +496,22 @@ class Hub:
                 try:
                     msgs = ep.drain()
                 except (ConnectionResetError, EOFError, OSError):
-                    self.detach(ep)
+                    self.detach(ep, reason='read_error')
                     continue
+                if msgs:
+                    with self._lock:
+                        if ep in self._last_recv:
+                            self._last_recv[ep] = time.monotonic()
                 for msg in msgs:
+                    if is_heartbeat(msg):
+                        with self._lock:
+                            self._peer_info[ep] = msg[1]
+                            self.stats['heartbeats'] = (
+                                self.stats.get('heartbeats', 0) + 1)
+                        continue
                     self._inbox.put((ep, msg))
             self._apply_commands()
+            self._check_liveness()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,185 @@
+"""Fault-tolerance primitives for the distributed actor fleet.
+
+Three small pieces shared by the transport (connection.py), the actor tree
+(worker.py) and the learner's RPC server (train.py):
+
+* :class:`Backoff` — exponential reconnect delays with jitter, so a fleet
+  of gathers that lost the same server does not stampede it in lockstep
+  when it comes back.
+
+* :class:`TaskLedger` — the server's outstanding-task book. Every
+  generation/eval assignment is tracked per endpoint with a deadline;
+  tasks stranded by a detach or a deadline miss are re-queued for the next
+  'args' request, and late duplicate uploads (a gather resending an RPC it
+  never saw the ack for) are dropped exactly once — so ``num_episodes`` /
+  ``num_results`` accounting converges instead of drifting when actors
+  churn (the seed assigned tasks fire-and-forget, train.py:1523-1548).
+
+* :func:`parse_chaos` — the ``HANDYRL_TPU_CHAOS`` fault-injection knobs
+  used by the chaos tests and available for soak runs:
+  ``kill_gather=<mean s>`` (the worker host SIGKILLs a random gather child
+  on an exponential clock), ``kill_worker=<mean s>`` (each worker process
+  self-destructs after an exponentially distributed lifetime),
+  ``max_kills=<n>``, ``seed=<n>``.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import random
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, Optional
+
+
+class Backoff:
+    """Exponential backoff with jitter: delays double from ``initial`` up to
+    ``maximum``; each delay is uniformly jittered into
+    ``[(1 - jitter) * d, d]`` so synchronized failures desynchronize."""
+
+    def __init__(self, initial: float = 1.0, maximum: float = 30.0,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.initial = float(initial)
+        self.maximum = float(maximum)
+        self.factor = float(factor)
+        self.jitter = float(jitter)
+        self._rng = rng or random
+        self._cur = self.initial
+
+    def next_delay(self) -> float:
+        base = min(self._cur, self.maximum)
+        self._cur = min(self._cur * self.factor, self.maximum)
+        return base * (1.0 - self.jitter * self._rng.random())
+
+    def reset(self):
+        self._cur = self.initial
+
+
+class TaskLedger:
+    """Outstanding-task book for the learner's 4-RPC server.
+
+    ``assign`` stamps a fresh ``task_id`` into the task payload and books it
+    against the endpoint it was sent to, with a deadline. ``admit`` filters
+    an upload batch: items completing a booked task pass (and close the
+    book), items with an unknown ``task_id`` are duplicates (a resent RPC
+    whose first copy already landed) and are dropped, items with no
+    ``task_id`` pass untouched (pre-ledger peers). ``fail_endpoint`` /
+    ``reap`` move stranded tasks to the re-issue queue, which ``next_reissue``
+    serves ahead of fresh assignments — re-issues must NOT re-increment the
+    server's num_episodes/num_results counters, which is exactly why they
+    bypass the fresh-task construction path.
+    """
+
+    def __init__(self, deadline: float = 300.0, clock=time.time):
+        self.deadline = float(deadline)
+        self._clock = clock
+        self._tasks: Dict[int, tuple] = {}          # tid -> (endpoint, base, expires)
+        self._by_endpoint: Dict[Any, set] = defaultdict(set)
+        self._reissue: deque = deque()
+        self._next_tid = 0
+        self.stats: Dict[str, int] = {
+            'assigned': 0, 'completed': 0, 'duplicates': 0,
+            'reissued': 0, 'expired': 0, 'endpoint_failures': 0,
+        }
+
+    # -- assignment / completion --
+
+    def assign(self, endpoint, role_args: Dict[str, Any]) -> int:
+        """Book ``role_args`` against ``endpoint`` and stamp its task_id."""
+        tid, self._next_tid = self._next_tid, self._next_tid + 1
+        base = copy.deepcopy(
+            {k: v for k, v in role_args.items() if k != 'task_id'})
+        role_args['task_id'] = tid
+        self._tasks[tid] = (endpoint, base, self._clock() + self.deadline)
+        self._by_endpoint[endpoint].add(tid)
+        self.stats['assigned'] += 1
+        return tid
+
+    def complete(self, tid) -> bool:
+        """Close the book on ``tid``. False (and counted) for duplicates."""
+        entry = self._tasks.pop(tid, None)
+        if entry is None:
+            self.stats['duplicates'] += 1
+            return False
+        owners = self._by_endpoint.get(entry[0])
+        if owners is not None:
+            owners.discard(tid)
+            if not owners:
+                self._by_endpoint.pop(entry[0], None)
+        self.stats['completed'] += 1
+        return True
+
+    def admit(self, items):
+        """Filter an upload batch through the book (see class docstring)."""
+        out = []
+        for item in items:
+            if item is None:            # failed episode: deadline re-issues it
+                out.append(item)
+                continue
+            tid = (item.get('args') or {}).get('task_id')
+            if tid is None or self.complete(tid):
+                out.append(item)
+        return out
+
+    # -- loss handling --
+
+    def _strand(self, tid):
+        endpoint, base, _expires = self._tasks.pop(tid)
+        owners = self._by_endpoint.get(endpoint)
+        if owners is not None:
+            owners.discard(tid)
+            if not owners:
+                self._by_endpoint.pop(endpoint, None)
+        self._reissue.append(base)
+        self.stats['reissued'] += 1
+
+    def fail_endpoint(self, endpoint) -> int:
+        """Re-queue every task booked against a detached endpoint."""
+        tids = list(self._by_endpoint.get(endpoint, ()))
+        for tid in tids:
+            self._strand(tid)
+        if tids:
+            self.stats['endpoint_failures'] += 1
+        return len(tids)
+
+    def reap(self, now: Optional[float] = None) -> int:
+        """Re-queue every task past its deadline (slow/silently-lost work)."""
+        now = self._clock() if now is None else now
+        expired = [tid for tid, (_ep, _base, exp) in self._tasks.items()
+                   if exp <= now]
+        for tid in expired:
+            self._strand(tid)
+        self.stats['expired'] += len(expired)
+        return len(expired)
+
+    def next_reissue(self) -> Optional[Dict[str, Any]]:
+        return self._reissue.popleft() if self._reissue else None
+
+    # -- observability --
+
+    def outstanding(self) -> int:
+        return len(self._tasks)
+
+    def pending_reissue(self) -> int:
+        return len(self._reissue)
+
+
+def parse_chaos(spec: Optional[str] = None) -> Dict[str, float]:
+    """Parse ``HANDYRL_TPU_CHAOS`` (or an explicit spec string) into a dict
+    of float knobs; empty/unset means chaos off. Malformed entries are
+    ignored rather than crashing a production run."""
+    if spec is None:
+        spec = os.environ.get('HANDYRL_TPU_CHAOS', '')
+    out: Dict[str, float] = {}
+    for part in (spec or '').split(','):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition('=')
+        try:
+            out[key.strip()] = float(value)
+        except ValueError:
+            print('ignoring malformed HANDYRL_TPU_CHAOS entry %r' % part)
+    return out

@@ -26,6 +26,7 @@ the trainer falls behind.
 
 from __future__ import annotations
 
+import atexit
 from multiprocessing import shared_memory
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -107,6 +108,12 @@ class ArenaRing:
                      for _ in range(slots)]
         self.views = [map_batch(shm.buf, spec) for shm in self.shms]
         self.free: List[int] = list(range(slots))
+        self._closed = False
+        # the owning (child) process must unlink its segments on ANY exit —
+        # a crashed learner tree must not strand /dev/shm segments until
+        # reboot. atexit covers interpreter exits that bypass the builder
+        # loop's finally; close() is idempotent so both firing is fine.
+        atexit.register(self.close)
 
     @property
     def names(self) -> List[str]:
@@ -119,12 +126,22 @@ class ArenaRing:
         self.free.append(slot)
 
     def close(self):
-        for shm in self.shms:
+        if self._closed:
+            return
+        self._closed = True
+        shms, self.shms, self.views, self.free = self.shms, [], [], []
+        for shm in shms:
             try:
                 shm.close()
+            except Exception:
+                # live numpy views may pin the mapping (BufferError); the
+                # OS reclaims the mapping at process exit — what must not
+                # leak is the /dev/shm NAME, which unlink below removes
+                pass
+            try:
                 shm.unlink()
             except (FileNotFoundError, OSError):
-                pass
+                pass   # double-unlink (e.g. resource tracker won) is fine
 
 
 def copy_into(views: Dict[str, Any], batch: Dict[str, Any]):

@@ -45,6 +45,7 @@ import numpy as np
 import psutil
 
 from .environment import make_env, prepare_env
+from .fault import TaskLedger
 from .generation import BatchedEvaluator, BatchedGenerator
 from .model import ModelWrapper
 from .ops.batch import make_batch, select_episode
@@ -52,6 +53,7 @@ from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
 from .utils.fetch import put_tree
+from .utils.fs import atomic_write_bytes
 from .worker import WorkerCluster, WorkerServer
 
 
@@ -117,25 +119,31 @@ def _batcher_process_shm(conn, bid: int):
             slot = ring.acquire()
         return slot
 
-    while True:
-        selected, args = recv_job()
-        desc = {'bid': bid}
-        if not have_cache:
-            cache, have_cache = make_block_cache(args), True
-        if ring is None:
-            batch = make_batch(selected, args, timer=timer, cache=cache)
-            ring = ArenaRing(batch_spec(batch), slots=_SHM_SLOTS)
-            slot = ring.acquire()
-            copy_into(ring.views[slot], batch)
-            desc['spec'] = ring.spec
-            desc['names'] = ring.names
-        else:
-            slot = acquire_slot()
-            make_batch(selected, args, out=ring.views[slot], timer=timer,
-                       cache=cache)
-        desc['slot'] = slot
-        desc['timing'] = timer.snapshot(reset=True)
-        conn.send(desc)
+    try:
+        while True:
+            selected, args = recv_job()
+            desc = {'bid': bid}
+            if not have_cache:
+                cache, have_cache = make_block_cache(args), True
+            if ring is None:
+                batch = make_batch(selected, args, timer=timer, cache=cache)
+                ring = ArenaRing(batch_spec(batch), slots=_SHM_SLOTS)
+                slot = ring.acquire()
+                copy_into(ring.views[slot], batch)
+                desc['spec'] = ring.spec
+                desc['names'] = ring.names
+            else:
+                slot = acquire_slot()
+                make_batch(selected, args, out=ring.views[slot], timer=timer,
+                           cache=cache)
+            desc['slot'] = slot
+            desc['timing'] = timer.snapshot(reset=True)
+            conn.send(desc)
+    finally:
+        # this process OWNS the segments: unlink them on any exit (pipe
+        # EOF, crash, ...) so an aborted run strands nothing in /dev/shm
+        if ring is not None:
+            ring.close()
 
 
 class Batcher:
@@ -819,6 +827,7 @@ class Learner:
         self.remote = remote
         self.use_batched_generation = (not remote
                                        and args.get('batched_generation', True))
+        self.ledger: Optional[TaskLedger] = None   # built by server()
         self.worker = None
         if not self.use_batched_generation:
             self.worker = WorkerServer(args) if remote else WorkerCluster(args)
@@ -874,12 +883,12 @@ class Learner:
         self.wrapper.params = jax.tree_util.tree_map(np.asarray, params)
         os.makedirs(self.args.get('model_dir', 'models'), exist_ok=True)
         raw = self.wrapper.params_bytes()
+        # atomic (temp + fsync + rename): a crash mid-write must never leave
+        # a truncated latest.ckpt / trainer_state.ckpt for resume to load
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
-            with open(path, 'wb') as f:
-                f.write(raw)
+            atomic_write_bytes(path, raw)
         if state_blob is not None:
-            with open(self.trainer_state_path(), 'wb') as f:
-                f.write(state_blob)
+            atomic_write_bytes(self.trainer_state_path(), state_blob)
 
     # -- accounting -------------------------------------------------------
     def feed_episodes(self, episodes: List[Optional[dict]]):
@@ -1027,6 +1036,10 @@ class Learner:
                 self.trainer.ring_occupancy(), 4)
             rec['replay_sample_reuse'] = round(
                 stats['samples_drawn'] / max(1, stats['windows_ingested']), 3)
+        if getattr(self, 'ledger', None) is not None:
+            rec.update({'fleet_' + k: v
+                        for k, v in self._fleet_snapshot().items()
+                        if k != 'disconnects'})
         with open(self._metrics_path, 'a') as f:
             f.write(json.dumps(rec) + '\n')
 
@@ -1505,11 +1518,31 @@ class Learner:
     def server(self):
         """4-RPC conductor: args / episode / result / model
         (reference train.py:541-627; 'model' answers with an architecture
-        name + msgpack params snapshot, never pickled code)."""
+        name + msgpack params snapshot, never pickled code).
+
+        Every assigned task is booked in a :class:`TaskLedger` with a
+        deadline; tasks stranded on a detached endpoint (the Hub's
+        heartbeat/liveness machinery journals those) or past their deadline
+        are re-issued ahead of fresh assignments, WITHOUT re-incrementing
+        ``num_episodes``/``num_results`` — so episode accounting converges
+        and budgeted runs cannot hang waiting for episodes a dead host will
+        never deliver. Duplicate uploads (a gather resending an un-acked
+        RPC after reconnect) are dropped by the same book."""
         print('started server')
         cadence = _EpochCadence(self.args)
+        ft = self.args.get('fault_tolerance') or {}
+        ledger = self.ledger = TaskLedger(
+            deadline=float(ft.get('task_deadline', 300.0)))
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
+            # fleet supervision runs even when no RPC arrives: stranded
+            # tasks must re-enter the queue or the epoch cadence starves
+            for ep, reason, _t in self.worker.drain_detach_events():
+                lost = ledger.fail_endpoint(ep)
+                if lost:
+                    print('re-issuing %d task(s) from detached peer (%s)'
+                          % (lost, reason))
+            ledger.reap()
             try:
                 conn, (req, data) = self.worker.recv(timeout=0.3)
             except queue.Empty:
@@ -1525,34 +1558,37 @@ class Learner:
                     send_data = [None] * len(data)
                 else:
                     for _ in data:
-                        role_args = {'model_id': {}}
-                        if self.num_results < self.eval_rate * self.num_episodes:
-                            role_args['role'] = 'e'
-                        else:
-                            role_args['role'] = 'g'
+                        role_args = ledger.next_reissue()
+                        if role_args is None:
+                            role_args = {'model_id': {}}
+                            if self.num_results < self.eval_rate * self.num_episodes:
+                                role_args['role'] = 'e'
+                            else:
+                                role_args['role'] = 'g'
 
-                        if role_args['role'] == 'g':
-                            role_args['player'] = self.env.players()
-                            for p in self.env.players():
-                                role_args['model_id'][p] = self.model_epoch
-                            self.num_episodes += 1
-                        else:
-                            players = self.env.players()
-                            role_args['player'] = [
-                                players[self.num_results % len(players)]]
-                            for p in players:
-                                role_args['model_id'][p] = (
-                                    self.model_epoch if p in role_args['player']
-                                    else -1)
-                            self.num_results += 1
+                            if role_args['role'] == 'g':
+                                role_args['player'] = self.env.players()
+                                for p in self.env.players():
+                                    role_args['model_id'][p] = self.model_epoch
+                                self.num_episodes += 1
+                            else:
+                                players = self.env.players()
+                                role_args['player'] = [
+                                    players[self.num_results % len(players)]]
+                                for p in players:
+                                    role_args['model_id'][p] = (
+                                        self.model_epoch if p in role_args['player']
+                                        else -1)
+                                self.num_results += 1
+                        ledger.assign(conn, role_args)
                         send_data.append(role_args)
 
             elif req == 'episode':
-                self.feed_episodes(data)
+                self.feed_episodes(ledger.admit(data))
                 send_data = [None] * len(data)
 
             elif req == 'result':
-                self.feed_results(data)
+                self.feed_results(ledger.admit(data))
                 send_data = [None] * len(data)
 
             elif req == 'model':
@@ -1585,9 +1621,46 @@ class Learner:
 
             if cadence.due(self.num_returned_episodes):
                 self.update()
+                self._print_fleet_stats()
                 if self._past_epoch_budget():
                     self.shutdown_flag = True
         print('finished server')
+
+    def _fleet_snapshot(self) -> Dict[str, Any]:
+        """Aggregate fleet health: server-side ledger + hub counters plus
+        the per-gather stats that ride in on heartbeat payloads."""
+        led = self.ledger.stats
+        hub = self.worker.hub_stats()
+        peers = self.worker.peer_info().values()
+        snap = {
+            'live': self.worker.connection_count(),
+            'outstanding': self.ledger.outstanding(),
+            'pending_reissue': self.ledger.pending_reissue(),
+            'reissued': led['reissued'],
+            'expired': led['expired'],
+            'duplicates_dropped': led['duplicates'],
+            'detached': hub.get('detached', 0),
+            'reconnects': sum(int((p or {}).get('reconnects', 0))
+                              for p in peers),
+            'dropped_uploads': sum(int((p or {}).get('dropped_uploads', 0))
+                                   for p in peers),
+        }
+        reasons = {k[len('disconnect_'):]: v for k, v in hub.items()
+                   if k.startswith('disconnect_')}
+        if reasons:
+            snap['disconnects'] = reasons
+        return snap
+
+    def _print_fleet_stats(self):
+        if getattr(self, 'ledger', None) is None:
+            return
+        snap = self._fleet_snapshot()
+        reasons = snap.pop('disconnects', {})
+        line = ' '.join('%s=%s' % kv for kv in snap.items())
+        if reasons:
+            line += ' (%s)' % ', '.join(
+                '%s=%d' % kv for kv in sorted(reasons.items()))
+        print('fleet: ' + line)
 
     def shutdown(self):
         """Stop the trainer loop and join its thread so no daemon thread is

@@ -1,0 +1,35 @@
+"""Crash-safe file writes for checkpoints.
+
+``latest.ckpt`` / ``trainer_state.ckpt`` are exactly the files a resumed
+run loads, so an in-place ``open(path, 'wb')`` is the worst possible place
+to die: a crash mid-write leaves a truncated file that poisons the next
+start. Writes go to a temp file in the SAME directory (os.replace must not
+cross filesystems), are fsynced, then atomically renamed over the target —
+a reader sees either the old bytes or the new bytes, never a prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    """Write ``data`` to ``path`` atomically (temp file + fsync + rename)."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + '.tmp.',
+                               dir=directory)
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # crash/interrupt before publish: the target is untouched; don't
+        # litter the checkpoint dir with partial temp files
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

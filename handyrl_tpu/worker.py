@@ -20,24 +20,33 @@ data connections on port 9998 all match the reference topology
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import queue
 import random
 import threading
 import time
+import traceback
 from collections import OrderedDict, defaultdict, deque
 from socket import gethostname
 from typing import Any, Dict, Optional
 
-from .connection import (Hub, accept_socket_connections,
+from .connection import (HEARTBEAT_KIND, Hub, accept_socket_connections,
                          connect_socket_connection, force_cpu_backend,
                          send_recv, spawn_pipe_workers)
 from .environment import make_env, prepare_env
 from .evaluation import Evaluator
+from .fault import Backoff, parse_chaos
 from .generation import Generator
 from .model import ModelWrapper, RandomModel
 
-ENTRY_PORT = 9999
-DATA_PORT = 9998
+# Overridable so several learner/worker fleets (or parallel test runs) can
+# share one host without colliding on the well-known ports.
+ENTRY_PORT = int(os.environ.get('HANDYRL_TPU_ENTRY_PORT', 9999))
+DATA_PORT = int(os.environ.get('HANDYRL_TPU_DATA_PORT', 9998))
+
+# connection-death signatures on the blocking RPC paths (sockets AND pipes);
+# socket.timeout / Broken/ResetError are OSError subclasses
+_CONN_ERRORS = (OSError, EOFError, ConnectionError)
 
 
 class ModelVault:
@@ -114,13 +123,42 @@ class Worker:
         print('closed worker %d' % self.worker_id)
 
     def run(self):
+        """Supervised task loop: a broken pipe to the gather ends the
+        process (the gather's supervisor respawns the whole subtree), but a
+        crashing episode only costs that one episode — the payload becomes
+        None (skipped server-side; the task ledger re-issues it on
+        deadline) and the loop keeps serving."""
+        chaos = parse_chaos()
+        doom = None
+        if chaos.get('kill_worker'):
+            rng = random.Random(int(chaos.get('seed', 0)) * 7919
+                                + self.worker_id)
+            doom = time.time() + rng.expovariate(1.0 / chaos['kill_worker'])
         while True:
-            task = send_recv(self.conn, ('args', None))
+            if doom is not None and time.time() >= doom:
+                print('chaos: worker %d self-destructing' % self.worker_id,
+                      flush=True)
+                os._exit(17)
+            try:
+                task = send_recv(self.conn, ('args', None))
+            except _CONN_ERRORS:
+                print('worker %d: lost its gather; exiting' % self.worker_id)
+                return
             if task is None:
-                break
+                return
             produce, upload_as = self.playbook[task['role']]
-            models = self.vault.obtain(dict(task.get('model_id', {})))
-            send_recv(self.conn, (upload_as, produce(models, task)))
+            try:
+                models = self.vault.obtain(dict(task.get('model_id', {})))
+                payload = produce(models, task)
+            except _CONN_ERRORS:       # model fetch rode the dead pipe
+                return
+            except Exception:
+                traceback.print_exc()
+                payload = None
+            try:
+                send_recv(self.conn, (upload_as, payload))
+            except _CONN_ERRORS:
+                return
 
 
 def open_worker(args, conn, wid):
@@ -140,12 +178,40 @@ class Gather:
     in blocks, model snapshots are served from a per-id cache, and episode /
     result uploads are batched before shipping. State lives in three small
     stores; routing is a dispatch over the RPC kind.
+
+    Fault tolerance (remote mode, i.e. ``reconnect`` given): every server
+    RPC is supervised — a socket failure closes the connection, redials the
+    data port with exponential backoff + jitter, and retries the same RPC,
+    so batched ``_upload_box`` contents survive a severed link instead of
+    dying with it (an RPC whose ack was lost is resent; the server's task
+    ledger drops the duplicate). A daemon thread additionally sends one-way
+    heartbeat frames carrying this relay's fleet stats, so the server's Hub
+    can detach silently-dead peers and the learner can aggregate
+    reconnect/drop counts per epoch.
     """
 
-    def __init__(self, args: Dict[str, Any], server_conn, gather_id: int):
+    def __init__(self, args: Dict[str, Any], server_conn, gather_id: int,
+                 reconnect=None):
         print('started gather %d' % gather_id)
         self.gather_id = gather_id
+        ft = args.get('fault_tolerance') or {}
+        self._reconnect_fn = reconnect
+        self._rpc_timeout = float(ft.get('rpc_timeout', 120.0))
+        self._hb_interval = float(ft.get('heartbeat_interval', 10.0))
+        self._backoff_initial = float(ft.get('reconnect_initial_delay', 1.0))
+        self._backoff_max = float(ft.get('reconnect_max_delay', 30.0))
+        self._max_tries = int(ft.get('reconnect_max_tries', 30))
+        self._resend_max = int(ft.get('resend_buffer', 256))
+        self.stats = {'reconnects': 0, 'dropped_uploads': 0}
+        if server_conn is None and reconnect is not None:
+            server_conn = self._dial()   # child-side dial (respawn-friendly)
         self.server = server_conn
+        if getattr(server_conn, 'sock', None) is not None:
+            # a silently-dead server must fail the blocking recv, not hang it
+            server_conn.sock.settimeout(self._rpc_timeout)
+            if self._hb_interval > 0:
+                threading.Thread(target=self._heartbeat_loop,
+                                 daemon=True).start()
 
         n_total = args['worker']['num_parallel']
         n_relays = args['worker']['num_gathers']
@@ -168,12 +234,71 @@ class Gather:
     def __del__(self):
         print('finished gather %d' % self.gather_id)
 
+    # -- supervised server link --
+
+    def _dial(self):
+        return self._reconnect_fn()
+
+    def _heartbeat_loop(self):
+        """One-way liveness beacons, sent even while the main loop blocks
+        inside a long RPC (e.g. the server is busy at an epoch boundary).
+        FramedConnection.send serializes with the RPC path internally."""
+        while True:
+            time.sleep(self._hb_interval)
+            conn = self.server
+            try:
+                conn.send((HEARTBEAT_KIND,
+                           {'gather': self.gather_id, **self.stats}))
+            except Exception:
+                pass   # the RPC path owns failure handling and reconnect
+
+    def _recover(self, exc: Exception):
+        """Redial the data port with exponential backoff + jitter (the
+        ``entry()`` retry pattern, hardened)."""
+        print('gather %d: server link lost (%s: %s); reconnecting'
+              % (self.gather_id, type(exc).__name__, str(exc)[:120]),
+              flush=True)
+        try:
+            self.server.close()
+        except Exception:
+            pass
+        backoff = Backoff(self._backoff_initial, self._backoff_max)
+        last_err: Optional[Exception] = exc
+        for _ in range(self._max_tries):
+            time.sleep(backoff.next_delay())
+            try:
+                conn = self._dial()
+            except OSError as e:
+                last_err = e
+                continue
+            conn.sock.settimeout(self._rpc_timeout)
+            self.server = conn
+            self.stats['reconnects'] += 1
+            print('gather %d: reconnected to the server' % self.gather_id,
+                  flush=True)
+            return
+        raise ConnectionError(
+            'gather %d: could not re-reach the server after %d tries (%s)'
+            % (self.gather_id, self._max_tries, last_err))
+
+    def _server_rpc(self, msg):
+        """send_recv with supervised reconnect; the in-flight request is
+        resent on the fresh link (the server dedupes by task_id, so a
+        request whose ack was lost cannot double-count)."""
+        while True:
+            try:
+                return send_recv(self.server, msg)
+            except _CONN_ERRORS as exc:
+                if self._reconnect_fn is None:   # pipe mode: not recoverable
+                    raise
+                self._recover(exc)
+
     # -- per-RPC handling --
 
     def _next_task(self):
         if not self._task_stock:
             self._task_stock.extend(
-                send_recv(self.server, ('args', [None] * self.block)))
+                self._server_rpc(('args', [None] * self.block)))
         return self._task_stock.popleft()
 
     def _snapshot(self, mid):
@@ -183,18 +308,26 @@ class Gather:
         if mid not in self._snap_cache:
             while len(self._snap_cache) >= self.SNAP_SLOTS:
                 self._snap_cache.popitem(last=False)
-            self._snap_cache[mid] = send_recv(self.server, ('model', mid))
+            self._snap_cache[mid] = self._server_rpc(('model', mid))
         self._snap_cache.move_to_end(mid)
         return self._snap_cache[mid]
 
     def _stash_upload(self, kind: str, payload):
         self._upload_box[kind].append(payload)
         self._upload_count += 1
+        while self._upload_count > self._resend_max:
+            # bounded resend buffer: under a long outage, keep the newest
+            # uploads and count the sacrifice instead of growing forever
+            biggest = max(self._upload_box, key=lambda k: len(self._upload_box[k]))
+            self._upload_box[biggest].pop(0)
+            self._upload_count -= 1
+            self.stats['dropped_uploads'] += 1
         if self._upload_count >= self.block:
-            for kind, batch in self._upload_box.items():
-                send_recv(self.server, (kind, batch))
-            self._upload_box.clear()
-            self._upload_count = 0
+            for kind in list(self._upload_box):
+                self._server_rpc((kind, self._upload_box[kind]))
+                # acked: this kind's batch is safely booked server-side
+                del self._upload_box[kind]
+            self._upload_count = sum(len(v) for v in self._upload_box.values())
 
     def run(self):
         while self.hub.count() > 0:
@@ -211,9 +344,14 @@ class Gather:
                 self._stash_upload(kind, body)
 
 
-def gather_loop(args, conn, gather_id):
+def gather_loop(args, conn, gather_id, server_address=None):
     force_cpu_backend()
-    Gather(args, conn, gather_id).run()
+    reconnect = None
+    if server_address:
+        def reconnect():
+            return connect_socket_connection(server_address,
+                                             WorkerServer.WORKER_PORT)
+    Gather(args, conn, gather_id, reconnect=reconnect).run()
 
 
 def default_num_gathers(num_parallel: int) -> int:
@@ -228,6 +366,9 @@ class WorkerCluster:
     def __init__(self, args: Dict[str, Any]):
         self.args = args
         self.hub = Hub()
+        ft = args.get('fault_tolerance') or {}
+        self.hub.LIVENESS_TIMEOUT = float(
+            ft.get('liveness_timeout', Hub.LIVENESS_TIMEOUT))
 
     def connection_count(self) -> int:
         return self.hub.count()
@@ -237,6 +378,16 @@ class WorkerCluster:
 
     def send(self, conn, data):
         self.hub.send(conn, data)
+
+    # fleet observability, consumed by the learner's ledger + epoch stats
+    def hub_stats(self) -> Dict[str, int]:
+        return self.hub.stats_snapshot()
+
+    def peer_info(self) -> Dict[Any, Any]:
+        return self.hub.peer_info_snapshot()
+
+    def drain_detach_events(self):
+        return self.hub.drain_detach_events()
 
     def run(self):
         wargs = self.args['worker']
@@ -285,9 +436,11 @@ class WorkerServer(WorkerCluster):
 
 def entry(worker_args, retries: int = 30, delay: float = 2.0):
     """Entry handshake with retry: the learner may still be starting (jax
-    import + bind) when a worker host comes up."""
+    import + bind) when a worker host comes up. Retries back off with
+    jitter so a whole fleet booting at once does not hammer in lockstep."""
     last_err: Optional[Exception] = None
     port = WorkerServer.ENTRY_PORT
+    backoff = Backoff(delay, maximum=4 * delay)
     for _ in range(retries):
         try:
             conn = connect_socket_connection(
@@ -299,14 +452,25 @@ def entry(worker_args, retries: int = 30, delay: float = 2.0):
                 conn.close()
         except (OSError, ConnectionResetError) as e:
             last_err = e
-            time.sleep(delay)
+            time.sleep(backoff.next_delay())
     raise ConnectionError('could not reach training server at %s:%d (%s)'
                           % (worker_args['server_address'], port, last_err))
 
 
 class RemoteWorkerCluster:
     """Remote mode, worker-host side: entry handshake, then one data socket
-    per gather, each driven by its own spawned process."""
+    per gather, each driven by its own spawned process — plus a supervisor
+    that respawns crashed gathers (with per-slot backoff) instead of
+    sleeping forever next to a shrinking fleet. A gather that exits cleanly
+    (exit code 0: the server handed out a None task, training is over) is
+    retired, so the host process itself terminates when the run ends.
+
+    ``HANDYRL_TPU_CHAOS=kill_gather=<mean s>[,max_kills=N][,seed=S]`` arms
+    a fault injector that SIGKILLs random gather children on an exponential
+    clock — the chaos tests (and soak runs) prove the supervisor + task
+    ledger recover."""
+
+    RESPAWN_RESET_AFTER = 60.0   # gather alive this long => backoff resets
 
     def __init__(self, args: Dict[str, Any]):
         args['address'] = gethostname()
@@ -320,21 +484,75 @@ class RemoteWorkerCluster:
         prepare_env(merged['env'])
 
         ctx = mp.get_context('spawn')
-        children = []
+        address = self.args['server_address']
+        ft = merged.get('fault_tolerance') or {}
+        max_fails = int(ft.get('reconnect_max_tries', 30))
+
+        chaos = parse_chaos()
+        rng = random.Random(int(chaos.get('seed', 0)))
+        kills_left = int(chaos.get('max_kills', 1 << 30))
+        next_kill = None
+        if chaos.get('kill_gather'):
+            next_kill = time.time() + rng.expovariate(
+                1.0 / chaos['kill_gather'])
+
+        def spawn(i):
+            # the gather dials the data port itself: respawns need no
+            # parent-held socket, and a half-dead link is its own problem
+            proc = ctx.Process(target=gather_loop,
+                               args=(merged, None, i, address))
+            proc.start()
+            return proc
+
+        n = self.args['num_gathers']
+        children = {i: spawn(i) for i in range(n)}
+        started_at = {i: time.time() for i in children}
+        backoffs = {i: Backoff(float(ft.get('reconnect_initial_delay', 1.0)),
+                               float(ft.get('reconnect_max_delay', 30.0)))
+                    for i in children}
+        fails = {i: 0 for i in children}
         try:
-            for i in range(self.args['num_gathers']):
-                sock = connect_socket_connection(
-                    self.args['server_address'], WorkerServer.WORKER_PORT)
-                proc = ctx.Process(target=gather_loop,
-                                   args=(merged, sock, i))
-                proc.start()
-                sock.close()
-                children.append(proc)
-            while True:
-                time.sleep(100)
+            while children:
+                time.sleep(0.5)
+                now = time.time()
+                if next_kill is not None and now >= next_kill:
+                    if kills_left > 0:
+                        live = [i for i, p in children.items() if p.is_alive()]
+                        if live:
+                            victim = rng.choice(live)
+                            print('chaos: killing gather %d' % victim,
+                                  flush=True)
+                            children[victim].kill()
+                            kills_left -= 1
+                    next_kill = now + rng.expovariate(
+                        1.0 / chaos['kill_gather'])
+                for i, proc in list(children.items()):
+                    if proc.is_alive():
+                        if (fails[i] and
+                                now - started_at[i] > self.RESPAWN_RESET_AFTER):
+                            fails[i] = 0
+                            backoffs[i].reset()
+                        continue
+                    if proc.exitcode == 0:
+                        del children[i]   # clean exit: training ended
+                        continue
+                    fails[i] += 1
+                    if fails[i] > max_fails:
+                        # likely the server is gone for good — stop churning
+                        print('gather %d: giving up after %d failed respawns'
+                              % (i, fails[i] - 1), flush=True)
+                        del children[i]
+                        continue
+                    delay = backoffs[i].next_delay()
+                    print('gather %d died (exit %s); respawning in %.1fs'
+                          % (i, proc.exitcode, delay), flush=True)
+                    time.sleep(delay)
+                    children[i] = spawn(i)
+                    started_at[i] = time.time()
         finally:
-            for proc in children:
-                proc.terminate()
+            for proc in children.values():
+                if proc.is_alive():
+                    proc.terminate()
 
 
 def worker_main(args, argv):

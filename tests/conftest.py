@@ -55,6 +55,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers',
         'timeout(seconds): fail the test if it runs longer than the deadline')
+    config.addinivalue_line(
+        'markers',
+        'slow: excluded from the tier-1 run (-m "not slow"); exercised by '
+        'dedicated CI steps (e.g. the chaos smoke)')
 
 
 # Socket/multiprocess integration tests rely on POSIX semantics (SIGALRM
@@ -64,6 +68,7 @@ _POSIX_ONLY_FILES = (
     'test_remote_cluster.py', 'test_network.py', 'test_cluster.py',
     'test_cli.py', 'test_eval_cli.py', 'test_multihost.py',
     'test_batcher_processes.py', 'test_stress.py',
+    'test_fault_tolerance.py',
 )
 
 

@@ -1,0 +1,105 @@
+"""Chaos TCP proxy: a fault-injection man-in-the-middle for localhost tests.
+
+Forwards byte streams between clients and a target port, and can inject
+the three transport faults the fleet must survive:
+
+* ``sever()``   — hard-close every live connection (peers see RST/EOF);
+* ``blackhole`` — keep connections open but swallow all bytes (a silently
+  dead peer: exactly the half-open-TCP case heartbeat liveness exists for);
+* ``delay``     — per-chunk forwarding latency (slow WAN links).
+"""
+
+import socket
+import threading
+import time
+
+
+def _hard_close(sock):
+    """shutdown() then close(): a bare close() of an fd another pump thread
+    is blocked in recv() on does NOT release the kernel socket (the
+    in-flight syscall pins it), so no FIN/RST ever reaches the peer —
+    exactly the EOF the tests need to propagate. shutdown() disconnects
+    immediately and wakes the blocked recv."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ChaosProxy:
+    def __init__(self, target_port: int, listen_port: int = 0,
+                 target_host: str = '127.0.0.1'):
+        self._target = (target_host, int(target_port))
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(('127.0.0.1', int(listen_port)))
+        self.port = self._lsock.getsockname()[1]
+        self._lsock.listen(64)
+        self._conns = []
+        self._lock = threading.Lock()
+        self.accepting = True
+        self.blackhole = False
+        self.delay = 0.0
+        self.accepted = 0
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return
+            if not self.accepting:
+                client.close()
+                continue
+            try:
+                upstream = socket.create_connection(self._target, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            self.accepted += 1
+            with self._lock:
+                self._conns.append((client, upstream))
+            for src, dst in ((client, upstream), (upstream, client)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        while True:
+            try:
+                data = src.recv(1 << 16)
+            except OSError:
+                break
+            if not data:
+                break
+            if self.delay:
+                time.sleep(self.delay)
+            if self.blackhole:
+                continue          # swallow silently: peer looks alive-but-mute
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            _hard_close(s)
+
+    def sever(self):
+        """Hard-close every live proxied connection."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for pair in conns:
+            for s in pair:
+                _hard_close(s)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.sever()

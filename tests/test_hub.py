@@ -1,9 +1,13 @@
-"""Hub fairness: one stalled peer must not delay another peer's traffic.
+"""Hub fairness + liveness: one stalled peer must not delay another peer's
+traffic, and a silently-dead peer must not hold a slot forever.
 
 The reference serves each worker with its own connection thread
 (reference connection.py:198-244), so a stalled worker never slows the
 rest. The Hub keeps that property with per-endpoint outboxes + writer
-threads behind one selector read loop; these tests pin it down.
+threads behind one selector read loop; these tests pin it down, plus the
+heartbeat/liveness machinery (a peer that stops reading AND writing
+without closing its socket — half-open TCP — is detached within the
+liveness deadline) and the per-reason disconnect counters.
 """
 
 import socket
@@ -11,7 +15,7 @@ import time
 
 import pytest
 
-from handyrl_tpu.connection import FramedConnection, Hub
+from handyrl_tpu.connection import HEARTBEAT_KIND, FramedConnection, Hub
 
 
 def _pair(sndbuf=None):
@@ -64,13 +68,18 @@ def test_outbox_overflow_detaches_stalled_peer(monkeypatch):
     monkeypatch.setattr(Hub, 'OUTBOX_MAX', 4)
     hub = Hub()
     stalled_ep, _client = _pair(sndbuf=4096)
+    live_ep, live_client = _pair()
     hub.attach(stalled_ep)
+    hub.attach(live_ep)
     blob = b'y' * 65536
     deadline = time.time() + 10
-    while hub.count() == 1 and time.time() < deadline:
+    while hub.count() == 2 and time.time() < deadline:
         hub.send(stalled_ep, blob)
         time.sleep(0.01)
-    assert hub.count() == 0       # hopelessly-behind peer detached
+    assert hub.count() == 1       # hopelessly-behind peer detached...
+    assert hub.stats_snapshot()['disconnect_outbox_overflow'] == 1
+    hub.send(live_ep, {'seq': 1})  # ...without stalling the healthy peer
+    assert live_client.recv() == {'seq': 1}
 
 
 def test_detach_drops_sends():
@@ -81,7 +90,63 @@ def test_detach_drops_sends():
     assert client.recv() == 'first'
     hub.detach(ep)
     hub.send(ep, 'second')        # dropped, no error
+    hub.detach(ep)                # double detach: no error, counted once
     assert hub.count() == 0
+    assert hub.stats_snapshot()['detached'] == 1
+
+
+def test_silent_peer_detached_within_liveness_deadline():
+    """A peer that stops reading/writing WITHOUT closing its socket (the
+    half-open TCP case) is detached once its liveness deadline lapses, and
+    the detach is journaled for the server's task ledger."""
+    hub = Hub()
+    ep, client = _pair()
+    hub.attach(ep, liveness=1.0)
+    client.send({'hello': 1})
+    hub.recv(timeout=5)
+    assert hub.count() == 1
+    deadline = time.time() + 10    # ...then the client goes silent
+    while hub.count() == 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert hub.count() == 0
+    assert hub.stats_snapshot()['disconnect_heartbeat_miss'] == 1
+    events = hub.drain_detach_events()
+    assert len(events) == 1
+    assert events[0][0] is ep and events[0][1] == 'heartbeat_miss'
+    assert hub.drain_detach_events() == []   # drained exactly once
+
+
+def test_heartbeats_keep_peer_alive_and_stay_out_of_inbox():
+    hub = Hub()
+    ep, client = _pair()
+    hub.attach(ep, liveness=1.5)
+    for _ in range(6):             # 2.4s of beacons > 1.5s deadline
+        client.send((HEARTBEAT_KIND, {'gather': 7, 'reconnects': 2}))
+        time.sleep(0.4)
+    assert hub.count() == 1        # beacons refreshed the deadline
+    client.send(('real', 1))
+    _ep, msg = hub.recv(timeout=5)
+    assert msg == ['real', 1]      # heartbeats were filtered out
+    assert hub.peer_info_snapshot()[ep] == {'gather': 7, 'reconnects': 2}
+    assert hub.stats_snapshot()['heartbeats'] >= 6
+
+
+def test_liveness_defaults_to_sockets_only():
+    hub = Hub()
+    ep, _client = _pair()
+    hub.attach(ep)
+    assert hub._liveness[ep] == Hub.LIVENESS_TIMEOUT   # socket: default on
+
+    class _FakePipe:
+        def fileno(self):
+            return -1
+
+        def close(self):
+            pass
+    pipe = _FakePipe()
+    hub.attach(pipe)
+    assert hub._liveness[pipe] == 0.0   # pipes carry no deadline
+    hub.detach(pipe)
 
 
 @pytest.mark.parametrize('n', [8])
