@@ -383,6 +383,7 @@ def run_ingest(probe: dict):
     import numpy as np
     import handyrl_tpu
     handyrl_tpu.honor_platform_env()
+    from handyrl_tpu import telemetry
     from handyrl_tpu.ops.batch import make_batch, make_batch_reference
     from handyrl_tpu.utils.timing import StageTimer
 
@@ -412,6 +413,12 @@ def run_ingest(probe: dict):
                                   timer=timer)
 
     default_geom = (B == 128 and T == 16)
+    # stage keys in the canonical telemetry order (telemetry.INGEST_STAGES
+    # is the one vocabulary shared by bench rows, the HANDYRL_TPU_TIMING
+    # epoch line, and the exported stage_seconds histograms)
+    snap = timer.snapshot()
+    stages = {s: snap[s] for s in telemetry.INGEST_STAGES if s in snap}
+    stages.update({s: snap[s] for s in snap if s not in stages})
     emit(new_bps, (new_bps / ref_bps) if ref_bps else 0.0,
          backend=probe.get('backend', 'unknown'),
          device=probe.get('device_kind', 'unknown'),
@@ -420,7 +427,7 @@ def run_ingest(probe: dict):
          reference_batches_per_sec=round(ref_bps, 2),
          vs_baseline_def=('arena builder / reference builder, identical '
                           'Batcher machinery'),
-         stages=timer.snapshot(),
+         stages=stages, run_id=telemetry.run_id(),
          geometry=('headline' if default_geom else 'dryrun'))
 
 

@@ -68,6 +68,11 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'resend_buffer': 256,          # max unacked uploads a gather retains across reconnects; older ones are dropped + counted
     },
 
+    # unified telemetry (docs/observability.md): metric registry + spans +
+    # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
+    'telemetry': True,            # collect metrics (near-zero cost off; also HANDYRL_TPU_TELEMETRY=0)
+    'telemetry_port': 0,          # serve Prometheus text format on this port (0 = exporter off)
+
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
     'decode_cache_blocks': 1024,  # LRU capacity (bz2 blocks) of the batchers' decoded-moment cache; recency-biased selection re-decodes the same blocks every batch without it. 0 disables; memory cost ~= blocks * compress_steps * per-moment bytes
     'batcher_shared_memory': False,  # with batcher_processes: children assemble batches in shared-memory arenas and the trainer maps them zero-copy (no pickle over the pipe); slots recycle after the staged device upload completes
@@ -139,6 +144,13 @@ def validate(args: Dict[str, Any]) -> None:
         assert float(ft['liveness_timeout']) > float(ft['heartbeat_interval']), \
             'liveness_timeout must exceed heartbeat_interval or every ' \
             'healthy peer is detached between beacons'
+    if ta.get('telemetry_port') is not None:
+        port = int(ta['telemetry_port'])
+        assert 0 <= port <= 65535, \
+            'telemetry_port must be a TCP port (0 disables the exporter)'
+        assert port == 0 or ta.get('telemetry', True), \
+            'telemetry_port needs telemetry enabled (the exporter serves ' \
+            'the registry the collection switch turns off)'
     if ta.get('batcher_shared_memory'):
         assert ta.get('batcher_processes'), \
             'batcher_shared_memory requires batcher_processes (the thread ' \

@@ -24,8 +24,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import telemetry
 from .ops.batch import MOMENT_KEYS, compress_moments
 from .utils.tree import map_structure, softmax, stack_structure
+
+# every finished episode from ANY engine counts here; per-process registries
+# ride the heartbeat frames, so the learner can attribute fleet generation
+# volume (and derive per-peer episodes/sec) without extra RPCs
+_EPISODES = telemetry.counter('episodes_generated_total')
+_STEPS = telemetry.counter('generation_steps_total')
 
 
 def _sample_action(policy: np.ndarray, legal_actions) -> tuple:
@@ -52,6 +59,8 @@ def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
         for i, m in reversed(list(enumerate(moments))):
             ret = (m['reward'][player] or 0) + args['gamma'] * ret
             moments[i]['return'][player] = ret
+    _EPISODES.inc()
+    _STEPS.inc(len(moments))
     return {
         'args': gen_args, 'steps': len(moments),
         'outcome': env.outcome(),
@@ -112,7 +121,8 @@ class Generator:
     def execute(self, models, gen_args) -> Optional[dict]:
         episode = self.generate(models, gen_args)
         if episode is None:
-            print('None episode in generation!')
+            telemetry.get_logger('generation').warning(
+                'None episode in generation!')
         return episode
 
 

@@ -25,6 +25,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
+
+_WINDOWS = telemetry.counter('replay_windows_ingested_total')
+_SAMPLES = telemetry.counter('replay_samples_drawn_total')
+_SIZE = telemetry.gauge('replay_ring_size')
+_OCC = telemetry.gauge('replay_ring_occupancy')
+
 
 def recency_slots(key, size, cursor, capacity: int, batch_size: int):
     """Draw ``batch_size`` ring slots with the reference's recency bias.
@@ -110,9 +117,13 @@ class DeviceReplay:
                                       jnp.asarray(self.cursor, jnp.int32))
         self.cursor = (self.cursor + n) % self.capacity
         self.size = min(self.size + n, self.capacity)
+        _WINDOWS.inc(n)
+        _SIZE.set(self.size)
+        _OCC.set(self.size / self.capacity)
 
     def sample(self, key, batch_size: int) -> Dict[str, Any]:
         assert self.size > 0, 'sampling from an empty replay buffer'
+        _SAMPLES.inc(batch_size)
         return self._sample_fn(self.buffers, key,
                                jnp.asarray(self.size, jnp.int32),
                                jnp.asarray(self.cursor, jnp.int32),

@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import telemetry
+
 _ALIGN = 64   # leaf offsets cache-line aligned (also keeps dtypes aligned)
 
 
@@ -108,6 +110,10 @@ class ArenaRing:
                      for _ in range(slots)]
         self.views = [map_batch(shm.buf, spec) for shm in self.shms]
         self.free: List[int] = list(range(slots))
+        self._slots = slots
+        # arena occupancy (slots in flight toward the trainer): a gauge
+        # pinned at the ring size means the trainer is the bottleneck
+        self._m_in_use = telemetry.gauge('shm_slots_in_use')
         self._closed = False
         # the owning (child) process must unlink its segments on ANY exit —
         # a crashed learner tree must not strand /dev/shm segments until
@@ -120,10 +126,13 @@ class ArenaRing:
         return [shm.name for shm in self.shms]
 
     def acquire(self) -> Optional[int]:
-        return self.free.pop(0) if self.free else None
+        slot = self.free.pop(0) if self.free else None
+        self._m_in_use.set(self._slots - len(self.free))
+        return slot
 
     def release(self, slot: int):
         self.free.append(slot)
+        self._m_in_use.set(self._slots - len(self.free))
 
     def close(self):
         if self._closed:

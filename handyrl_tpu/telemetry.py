@@ -1,0 +1,585 @@
+"""Unified telemetry: metric registry, span timing, leveled logging, exporter.
+
+One observability layer for the whole fleet (the Podracer lesson: scaling an
+IMPALA-style learner/actor system is gated on *seeing* where time and
+throughput go across processes). Four pieces, all stdlib-only:
+
+* **MetricRegistry** — process-local labeled counters, gauges, and
+  fixed-bucket histograms (p50/p95/p99 summaries). Thread-safe, and
+  near-zero cost when disabled (``HANDYRL_TPU_TELEMETRY=0`` or the
+  ``telemetry: false`` config knob): every mutator is a single flag check.
+  ``snapshot()`` returns a plain-data dict that survives the msgpack wire
+  codec, so worker and gather processes piggyback their registries on the
+  existing heartbeat frames and the learner merges them fleet-wide
+  (``merge_snapshots``: counters sum, gauges sum, histogram buckets add).
+
+* **Spans** — lightweight timed sections recorded as observations of the
+  ``stage_seconds{stage=...}`` histogram family, stamped with a run-scoped
+  ``run_id``. The stage vocabulary subsumes the ingest StageTimer's
+  canonical names (``INGEST_STAGES``): a bench row, a live epoch timing
+  line, and an exported histogram all speak the same stage language.
+
+* **Leveled logger** — ``get_logger()``; verbosity from
+  ``HANDYRL_TPU_LOG_LEVEL`` (debug/info/warning/error, default info).
+  Replaces the scattered bare ``print()`` status lines whose partial writes
+  interleave mid-line across the process tree. The reference-format result
+  lines (epoch / win rate / loss / updated model) stay on stdout — plot
+  tooling parses those.
+
+* **TelemetryExporter** — optional Prometheus-text-format HTTP endpoint
+  (stdlib http.server; ``telemetry_port`` config knob, off by default)
+  serving the learner's local registry plus the latest merged fleet
+  snapshot.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# enable/disable switch (near-zero cost when off)
+
+_ENABLED = os.environ.get('HANDYRL_TPU_TELEMETRY', '1').strip().lower() \
+    not in ('0', 'false', 'off')
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(flag: bool):
+    """Flip collection globally; mirrored into the environment so spawned
+    children (batchers, gathers, workers) inherit the choice."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+    os.environ['HANDYRL_TPU_TELEMETRY'] = '1' if _ENABLED else '0'
+
+
+# ---------------------------------------------------------------------------
+# run id: one identity for every record/span of a training run
+
+_RUN_ID = os.environ.get('HANDYRL_TPU_RUN_ID') or uuid.uuid4().hex[:12]
+
+
+def run_id() -> str:
+    return _RUN_ID
+
+
+def set_run_id(rid: Optional[str]):
+    """Adopt the learner's run id (workers receive it in the merged config);
+    mirrored into the environment so spawned children inherit it."""
+    global _RUN_ID
+    if rid:
+        _RUN_ID = str(rid)
+        os.environ['HANDYRL_TPU_RUN_ID'] = _RUN_ID
+
+
+# ---------------------------------------------------------------------------
+# leveled logger (multi-process safe: one line per record, stderr)
+
+_LOG_CONFIGURED = False
+_LOG_LOCK = threading.Lock()
+
+
+def _log_level() -> int:
+    name = os.environ.get('HANDYRL_TPU_LOG_LEVEL', 'info').strip().lower()
+    return {'debug': logging.DEBUG, 'info': logging.INFO,
+            'warning': logging.WARNING, 'warn': logging.WARNING,
+            'error': logging.ERROR}.get(name, logging.INFO)
+
+
+def get_logger(name: str = 'handyrl_tpu') -> logging.Logger:
+    """A logger under the ``handyrl_tpu`` root, configured once per process:
+    complete single lines to stderr (no more dot streams and status prints
+    from N processes splicing mid-line), level from HANDYRL_TPU_LOG_LEVEL."""
+    global _LOG_CONFIGURED
+    root = logging.getLogger('handyrl_tpu')
+    if not _LOG_CONFIGURED:
+        with _LOG_LOCK:
+            if not _LOG_CONFIGURED:
+                handler = logging.StreamHandler(sys.stderr)
+                handler.setFormatter(logging.Formatter(
+                    '[%(asctime)s %(levelname).1s %(process)d %(name)s] '
+                    '%(message)s', datefmt='%H:%M:%S'))
+                root.addHandler(handler)
+                root.setLevel(_log_level())
+                root.propagate = False
+                _LOG_CONFIGURED = True
+    if name in ('', 'handyrl_tpu'):
+        return root
+    return root.getChild(name.replace('handyrl_tpu.', '', 1))
+
+
+# ---------------------------------------------------------------------------
+# metric key codec: 'name' or 'name{k="v",k2="v2"}' (label keys sorted)
+
+_NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+
+
+def metric_key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ','.join('%s="%s"' % (k, str(labels[k]).replace('"', "'"))
+                     for k in sorted(labels))
+    return '%s{%s}' % (name, inner)
+
+
+def split_key(key: str) -> Tuple[str, str]:
+    """('name', 'k="v",...') — the label string is '' when unlabeled."""
+    if '{' not in key:
+        return key, ''
+    name, _, rest = key.partition('{')
+    return name, rest.rstrip('}')
+
+
+def relabel(snapshot: Dict[str, Any], **labels) -> Dict[str, Any]:
+    """A copy of ``snapshot`` with ``labels`` appended to every metric key
+    (the exporter tags the merged fleet snapshot with source="fleet")."""
+    extra = ','.join('%s="%s"' % (k, v) for k, v in sorted(labels.items()))
+
+    def rekey(key: str) -> str:
+        name, inner = split_key(key)
+        inner = (inner + ',' + extra) if inner else extra
+        return '%s{%s}' % (name, inner)
+
+    out = dict(snapshot)
+    for section in ('counters', 'gauges'):
+        out[section] = {rekey(k): v
+                        for k, v in (snapshot.get(section) or {}).items()}
+    out['hists'] = {rekey(k): dict(v)
+                    for k, v in (snapshot.get('hists') or {}).items()}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+# Default histogram buckets: latency-oriented, seconds. Fixed per metric for
+# the life of the process so fleet merges are bucket-aligned.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# Canonical ingest-path stage vocabulary, shared by StageTimer epoch lines,
+# BENCH_MODE=ingest rows, and the stage_seconds histogram family.
+INGEST_STAGES: Tuple[str, ...] = (
+    'select', 'decode', 'assemble', 'ipc', 'h2d', 'compute', 'drain')
+
+
+class Counter:
+    """Monotonic labeled counter."""
+
+    __slots__ = ('_lock', 'value')
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-value labeled gauge."""
+
+    __slots__ = ('_lock', 'value')
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, v: float):
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value = float(v)
+
+    def add(self, n: float = 1.0):
+        if not _ENABLED:
+            return
+        with self._lock:
+            self.value += n
+
+
+class Histogram:
+    """Fixed-bucket histogram with closed-form percentile summaries.
+
+    ``bounds`` are ascending upper edges; observations land in the first
+    bucket whose bound is >= the value (one overflow bucket past the last
+    bound). Quantiles interpolate linearly inside the winning bucket —
+    exact enough for p50/p95/p99 dashboards at 14 buckets.
+    """
+
+    __slots__ = ('_lock', 'bounds', 'buckets', 'sum', 'count')
+
+    def __init__(self, lock: threading.Lock,
+                 bounds: Sequence[float] = DEFAULT_BUCKETS):
+        self._lock = lock
+        self.bounds = tuple(float(b) for b in bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float):
+        if not _ENABLED:
+            return
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.buckets[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def observe_agg(self, total: float, n: int):
+        """Fold ``n`` events totalling ``total`` in (a StageTimer batch):
+        the mean lands in one bucket, sum/count stay exact."""
+        if not _ENABLED or n <= 0:
+            return
+        i = bisect.bisect_left(self.bounds, total / n)
+        with self._lock:
+            self.buckets[i] += n
+            self.sum += total
+            self.count += n
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return hist_quantile(self.bounds, self.buckets, self.count, q)
+
+
+def hist_quantile(bounds: Sequence[float], buckets: Sequence[int],
+                  count: int, q: float) -> float:
+    """Linear-interpolated quantile of a bucketed distribution (also used on
+    merged fleet histograms, where no Histogram object exists)."""
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    seen = 0.0
+    for i, n in enumerate(buckets):
+        if n <= 0:
+            continue
+        if seen + n >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            frac = (rank - seen) / n
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += n
+    return float(bounds[-1])
+
+
+class MetricRegistry:
+    """Process-local metric store. One lock guards every update (updates are
+    a few arithmetic ops; the timed sections themselves run unlocked)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = metric_key(name, labels)
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(key, Counter(self._lock))
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = metric_key(name, labels)
+        g = self._gauges.get(key)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(key, Gauge(self._lock))
+        return g
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        key = metric_key(name, labels)
+        h = self._hists.get(key)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(key,
+                                           Histogram(self._lock, buckets))
+        return h
+
+    @contextmanager
+    def span(self, stage: str, parent: Optional[str] = None):
+        """Timed section recorded under ``stage_seconds{stage=...}`` (plus a
+        DEBUG structured event carrying the run id and a monotonic stamp).
+        ``parent`` names the enclosing stage, keeping the select/decode/
+        assemble/ipc/h2d/compute/drain vocabulary hierarchical."""
+        if not _ENABLED:
+            yield
+            return
+        labels = {'stage': stage}
+        if parent:
+            labels['parent'] = parent
+        hist = self.histogram('stage_seconds', **labels)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            hist.observe(dt)
+            log = get_logger('span')
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug('span %s run=%s t=%.6f dur=%.6f parent=%s',
+                          stage, _RUN_ID, time.monotonic(), dt, parent or '-')
+
+    def observe_stage(self, stage: str, seconds: float, count: int = 1):
+        """StageTimer mirror: fold an ingest-stage timing batch into the
+        span histogram family (same canonical stage names)."""
+        if not _ENABLED:
+            return
+        self.histogram('stage_seconds', stage=stage).observe_agg(
+            seconds, count)
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        """Plain-data (msgpack/json-safe) dump of every metric; with
+        ``reset`` counters/histograms restart from zero (gauges keep their
+        last value — they are levels, not flows)."""
+        with self._lock:
+            snap = {
+                'run_id': _RUN_ID,
+                'time': time.time(),
+                'counters': {k: c.value for k, c in self._counters.items()},
+                'gauges': {k: g.value for k, g in self._gauges.items()},
+                'hists': {k: {'bounds': list(h.bounds),
+                              'buckets': list(h.buckets),
+                              'sum': h.sum, 'count': h.count}
+                          for k, h in self._hists.items()},
+            }
+            if reset:
+                for c in self._counters.values():
+                    c.value = 0
+                for h in self._hists.values():
+                    h.buckets = [0] * len(h.buckets)
+                    h.sum = 0.0
+                    h.count = 0
+        return snap
+
+
+# the process-global registry every subsystem instruments against
+REGISTRY = MetricRegistry()
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+span = REGISTRY.span
+snapshot = REGISTRY.snapshot
+
+
+# ---------------------------------------------------------------------------
+# fleet merge + summaries
+
+
+def merge_snapshots(snaps: List[Optional[Dict[str, Any]]]
+                    ) -> Dict[str, Any]:
+    """Fleet-wide aggregate of per-process snapshots.
+
+    Merge semantics: counters SUM (flows add across processes), gauges SUM
+    (queue depths and rates add; per-peer resolution survives via labels —
+    e.g. ``gather_episodes_per_sec{gather="3"}`` keys stay distinct),
+    histogram buckets ADD elementwise when bounds agree (a peer running
+    different bounds is skipped for that key rather than mis-binned).
+    """
+    out: Dict[str, Any] = {'run_id': _RUN_ID, 'time': time.time(),
+                           'counters': {}, 'gauges': {}, 'hists': {},
+                           'peers': 0}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        out['peers'] += 1
+        for k, v in (snap.get('counters') or {}).items():
+            out['counters'][k] = out['counters'].get(k, 0) + v
+        for k, v in (snap.get('gauges') or {}).items():
+            out['gauges'][k] = out['gauges'].get(k, 0.0) + v
+        for k, h in (snap.get('hists') or {}).items():
+            cur = out['hists'].get(k)
+            if cur is None:
+                out['hists'][k] = {'bounds': list(h['bounds']),
+                                   'buckets': list(h['buckets']),
+                                   'sum': float(h['sum']),
+                                   'count': int(h['count'])}
+            elif list(cur['bounds']) == list(h['bounds']):
+                cur['buckets'] = [a + b for a, b in
+                                  zip(cur['buckets'], h['buckets'])]
+                cur['sum'] += float(h['sum'])
+                cur['count'] += int(h['count'])
+    return out
+
+
+def summarize(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Compact form for metrics_jsonl: counters/gauges verbatim, histograms
+    reduced to count/sum/p50/p95/p99 (full buckets stay wire-only)."""
+    hists = {}
+    for k, h in (snap.get('hists') or {}).items():
+        n = int(h['count'])
+        hists[k] = {
+            'count': n, 'sum': round(float(h['sum']), 6),
+            'p50': round(hist_quantile(h['bounds'], h['buckets'], n, 0.50), 6),
+            'p95': round(hist_quantile(h['bounds'], h['buckets'], n, 0.95), 6),
+            'p99': round(hist_quantile(h['bounds'], h['buckets'], n, 0.99), 6),
+        }
+    out = {'counters': dict(snap.get('counters') or {}),
+           'gauges': {k: round(float(v), 6)
+                      for k, v in (snap.get('gauges') or {}).items()},
+           'hists': hists}
+    if snap.get('peers') is not None:
+        out['peers'] = snap['peers']
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+
+
+def _prom_value(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(snaps: List[Dict[str, Any]]) -> str:
+    """Render snapshots in Prometheus text exposition format 0.0.4.
+    Caller guarantees key disjointness across snapshots (the fleet snapshot
+    is relabeled with source="fleet")."""
+    types: Dict[str, str] = {}
+    lines_by_name: Dict[str, List[str]] = {}
+
+    def emit(name: str, labelstr: str, value, kind: str):
+        if not _NAME_RE.match(name):
+            return
+        types.setdefault(name, kind)
+        body = '%s{%s}' % (name, labelstr) if labelstr else name
+        lines_by_name.setdefault(name, []).append(
+            '%s %s' % (body, _prom_value(value)))
+
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for key, v in (snap.get('counters') or {}).items():
+            name, labelstr = split_key(key)
+            emit(name, labelstr, v, 'counter')
+        for key, v in (snap.get('gauges') or {}).items():
+            name, labelstr = split_key(key)
+            emit(name, labelstr, v, 'gauge')
+        for key, h in (snap.get('hists') or {}).items():
+            name, labelstr = split_key(key)
+            types.setdefault(name, 'histogram')
+            cum = 0
+            for bound, n in zip(list(h['bounds']) + ['+Inf'],
+                                h['buckets']):
+                cum += n
+                le = ('+Inf' if bound == '+Inf'
+                      else _prom_value(bound))
+                ls = (labelstr + ',' if labelstr else '') + 'le="%s"' % le
+                lines_by_name.setdefault(name, []).append(
+                    '%s_bucket{%s} %d' % (name, ls, cum))
+            suffix = '{%s}' % labelstr if labelstr else ''
+            lines_by_name.setdefault(name, []).append(
+                '%s_sum%s %s' % (name, suffix, _prom_value(h['sum'])))
+            lines_by_name.setdefault(name, []).append(
+                '%s_count%s %d' % (name, suffix, h['count']))
+
+    out: List[str] = []
+    for name in sorted(lines_by_name):
+        out.append('# TYPE %s %s' % (name, types[name]))
+        out.extend(lines_by_name[name])
+    return '\n'.join(out) + ('\n' if out else '')
+
+
+class TelemetryExporter:
+    """Prometheus-style scrape endpoint on stdlib http.server.
+
+    ``collect`` returns the snapshots to serve (called per scrape, so the
+    endpoint always shows live registry values); ``port=0`` binds an
+    ephemeral port (tests), a fixed port serves operators' scrape configs.
+    ``/metrics`` answers the exposition text; every other path 404s.
+    """
+
+    def __init__(self, collect: Callable[[], List[Dict[str, Any]]],
+                 port: int = 0, host: str = ''):
+        self._collect = collect
+        self._host = host
+        self._port = int(port)
+        self._server = None
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> 'TelemetryExporter':
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split('?')[0] not in ('/metrics', '/'):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_prometheus(exporter._collect()).encode()
+                except Exception as exc:   # a broken collector must not
+                    self.send_error(500, str(exc)[:120])   # kill the server
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/plain; version=0.0.4; charset=utf-8')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                get_logger('exporter').debug(fmt, *args)
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        get_logger('exporter').info('telemetry exporter serving /metrics '
+                                    'on port %d', self._port)
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# JSONL schema helper (shared by tests and the CI smoke script)
+
+FLEET_KEYS = ('epoch', 'steps', 'episodes', 'time', 'run_id', 'telemetry')
+
+
+def validate_metrics_line(line: str, fleet: bool = False) -> Dict[str, Any]:
+    """Parse one metrics_jsonl line and assert the telemetry schema: the
+    base keys always, plus the merged ``fleet_telemetry`` aggregate when
+    ``fleet`` (server-mode runs). Raises ValueError on any violation."""
+    rec = json.loads(line)
+    for key in FLEET_KEYS:
+        if key not in rec:
+            raise ValueError('metrics line missing %r: %s' % (key, line[:120]))
+    tel = rec['telemetry']
+    if not isinstance(tel, dict) or 'counters' not in tel:
+        raise ValueError('telemetry summary malformed: %r' % (tel,))
+    if fleet:
+        ft = rec.get('fleet_telemetry')
+        if not isinstance(ft, dict) or 'counters' not in ft:
+            raise ValueError('fleet_telemetry missing/malformed: %r' % (ft,))
+    return rec

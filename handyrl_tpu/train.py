@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 import psutil
 
+from . import telemetry
 from .environment import make_env, prepare_env
 from .fault import TaskLedger
 from .generation import BatchedEvaluator, BatchedGenerator
@@ -53,8 +54,10 @@ from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
 from .utils.fetch import put_tree
-from .utils.fs import atomic_write_bytes
+from .utils.fs import append_jsonl, atomic_write_bytes
 from .worker import WorkerCluster, WorkerServer
+
+_LOG = telemetry.get_logger('train')
 
 
 def _batcher_process(conn, bid: int):
@@ -62,7 +65,7 @@ def _batcher_process(conn, bid: int):
     from .connection import force_cpu_backend
     force_cpu_backend()
     from .ops.batch import make_block_cache
-    print('started batcher process %d' % bid)
+    _LOG.info('started batcher process %d', bid)
     cache, have_cache = None, False
     while True:
         selected, args = conn.recv()
@@ -94,7 +97,7 @@ def _batcher_process_shm(conn, bid: int):
     force_cpu_backend()
     from .ops.shm_batch import ArenaRing, batch_spec, copy_into
     from .utils.timing import StageTimer
-    print('started shm batcher process %d' % bid)
+    _LOG.info('started shm batcher process %d', bid)
     from .ops.batch import make_block_cache
     ring = None
     timer = StageTimer()
@@ -242,7 +245,7 @@ class Batcher:
                            lambda: pool.send_to(bid, ('__free__', slot)))
 
     def _worker(self, bid: int):
-        print('started batcher %d' % bid)
+        _LOG.info('started batcher %d', bid)
         while not self.stop_flag:
             try:
                 t0 = time.perf_counter()
@@ -263,9 +266,10 @@ class Batcher:
                     continue
 
     def batch(self, timeout: Optional[float] = None):
-        if self._executor is not None:
-            return self._executor.output_queue.get(timeout=timeout)
-        return self.output_queue.get(timeout=timeout)
+        q = (self._executor.output_queue if self._executor is not None
+             else self.output_queue)
+        telemetry.gauge('batcher_queue_depth').set(q.qsize())
+        return q.get(timeout=timeout)
 
     def stop(self):
         self.stop_flag = True
@@ -296,9 +300,9 @@ class Trainer:
             if args['batch_size'] % n_dev == 0:
                 self.mesh = make_mesh()
             else:
-                print('batch_size %d not divisible by %d devices; '
-                      'training on a single device'
-                      % (args['batch_size'], n_dev))
+                _LOG.warning('batch_size %d not divisible by %d devices; '
+                             'training on a single device',
+                             args['batch_size'], n_dev)
         # the step donates its input state (params/opt buffers reused in
         # place); the actor-facing wrapper keeps its own copy of the params,
         # refreshed only at epoch boundaries
@@ -317,7 +321,7 @@ class Trainer:
         # trainer loop; printed per epoch under HANDYRL_TPU_TIMING=1 and
         # reported by bench.py's BENCH_MODE=ingest
         from .utils.timing import StageTimer
-        self.ingest_timer = StageTimer()
+        self.ingest_timer = StageTimer(registry=telemetry.REGISTRY)
         self.batcher = Batcher(args, self.episodes, timer=self.ingest_timer)
         # depth of the device staging ring: how many batches are held as
         # in-flight device uploads ahead of the compiled step (config
@@ -381,6 +385,7 @@ class Trainer:
         self.last_steps_per_sec = 0.0
         self._profile_dir = args.get('profile_dir') or ''
         self._profiled = False
+        self._trace_active = False
 
     def build_replay_update(self, cfg: LossConfig):
         """The fused K-step replay trainer for ``cfg`` — the ONE place its
@@ -401,6 +406,30 @@ class Trainer:
 
     def _lr(self) -> float:
         return self.default_lr * self.data_cnt_ema / (1 + self.steps * 1e-5)
+
+    # -- profiler trace lifecycle -----------------------------------------
+    # stop_trace is reached from several paths (replay loop, threaded loop,
+    # abort/shutdown); jax raises on a second stop, so the state lives in
+    # ONE idempotent pair instead of per-path bookkeeping.
+    def _start_trace(self):
+        jax.profiler.start_trace(self._profile_dir)
+        self._profiled = True
+        self._trace_active = True
+
+    def _stop_trace(self):
+        """Idempotent, exception-safe stop: safe to call from any path, any
+        number of times, including after an abort inside the profiled
+        window (where jax may have torn the trace down already)."""
+        if not self._trace_active:
+            return
+        self._trace_active = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            _LOG.warning('profiler stop_trace failed (%s: %s)',
+                         type(exc).__name__, str(exc)[:120])
+        else:
+            _LOG.info('profiler trace written to %s', self._profile_dir)
 
     # -- full-state checkpointing (params + optimizer + schedule) ---------
     # The reference checkpoints the model only (optimizer state and RNG are
@@ -447,8 +476,7 @@ class Trainer:
         epoch_t0 = time.time()
 
         if self._profile_dir and not self._profiled and self.steps > 0:
-            jax.profiler.start_trace(self._profile_dir)
-            self._profiled = True
+            self._start_trace()
             profile_stop_at = self.steps + 20
         else:
             profile_stop_at = -1
@@ -529,9 +557,11 @@ class Trainer:
                             1, self.replay_stats['windows_ingested']):
                         time.sleep(0.05)
                         continue
+                t_dispatch = time.perf_counter()
                 self.state, self._sample_key, metrics = self.replay_update(
                     self.state, buffers, self._sample_key, size, cursor,
                     jnp.asarray(self.data_cnt_ema, jnp.float32))
+                timer.add('compute', time.perf_counter() - t_dispatch)
                 self.replay_stats['samples_drawn'] += (
                     self.args['batch_size'] * self.fused_steps)
                 pending_metrics.append(metrics)
@@ -546,9 +576,8 @@ class Trainer:
                     pending_metrics = []
                 if 0 <= profile_stop_at <= self.steps:
                     jax.block_until_ready(metrics['total'])
-                    jax.profiler.stop_trace()
+                    self._stop_trace()
                     profile_stop_at = -1
-                    print('profiler trace written to %s' % self._profile_dir)
                 continue
             if not staged:
                 top_up()
@@ -574,8 +603,7 @@ class Trainer:
             self.steps += 1
             if self.steps == profile_stop_at:
                 jax.block_until_ready(metrics['total'])
-                jax.profiler.stop_trace()
-                print('profiler trace written to %s' % self._profile_dir)
+                self._stop_trace()
 
         if pending_metrics:
             data_cnt += self._drain_metrics(pending_metrics)
@@ -693,7 +721,7 @@ class Trainer:
         return data_cnt
 
     def run(self):
-        print('waiting training')
+        _LOG.info('waiting training')
         while (len(self.episodes) < self.args['minimum_episodes']
                and getattr(self, 'seen_episodes', 0)
                < self.args['minimum_episodes']
@@ -707,7 +735,7 @@ class Trainer:
         if self.state is not None and not self.shutdown_flag:
             if self.replay is None:
                 self.batcher.run()
-            print('started training')
+            _LOG.info('started training')
         while not self.shutdown_flag:
             try:
                 if not self.failed:
@@ -724,6 +752,9 @@ class Trainer:
                 # optimizer must not keep minting checkpoint epochs
                 import traceback
                 traceback.print_exc()
+                # an abort inside the profiled window must not strand an
+                # open trace (nor crash a later stop with a double-stop)
+                self._stop_trace()
                 self.failed = True
                 params, state_blob = None, None
             self.update_flag = False
@@ -737,6 +768,7 @@ class Trainer:
 
     def shutdown(self):
         self.shutdown_flag = True
+        self._stop_trace()   # idempotent: a no-op unless a trace is open
         self.batcher.stop()
 
 
@@ -772,6 +804,16 @@ class Learner:
 
         self.args = args
         random.seed(args['seed'])
+
+        # -- unified telemetry: one run id for the whole fleet (workers
+        # receive it in the merged config and stamp their own registries),
+        # a master collection switch, and the optional Prometheus endpoint
+        if not args.get('telemetry', True):
+            telemetry.set_enabled(False)
+        args.setdefault('run_id', telemetry.run_id())
+        telemetry.set_run_id(args['run_id'])
+        self._last_fleet_telemetry: Optional[dict] = None
+        self._exporter = None
 
         self.env = make_env(env_args)
         eval_modify_rate = (args['update_episodes'] ** 0.85) / args['update_episodes']
@@ -840,6 +882,13 @@ class Learner:
                     self.trainer.load_state_bytes(f.read())
                 print('resumed trainer state (steps %d)' % self.trainer.steps)
         self._trainer_thread: Optional[threading.Thread] = None
+
+        # the scrape endpoint binds only once everything it reads (trainer,
+        # worker front-end) exists — a scrape can land any time after this
+        export_port = int(args.get('telemetry_port') or 0)
+        if export_port and telemetry.enabled():
+            self._exporter = telemetry.TelemetryExporter(
+                self._telemetry_snapshots, port=export_port).start()
 
         self._metrics_path = args.get('metrics_jsonl') or ''
         # optional wall-clock budget (absolute unix time): long quality runs
@@ -910,9 +959,12 @@ class Learner:
                                                      r2 + outcome ** 2)
             self.num_returned_episodes += 1
             if self.num_returned_episodes % 100 == 0:
-                print(self.num_returned_episodes, end=' ', flush=True)
+                # complete line at debug level, not a bare dot stream that
+                # splices mid-line with worker-process output
+                _LOG.debug('returned %d episodes', self.num_returned_episodes)
 
         live = [e for e in episodes if e is not None]
+        telemetry.counter('learner_episodes_returned_total').inc(len(live))
         self.trainer.episodes.extend(live)
         if self.trainer.ingest_queue is not None:
             # best-effort under backlog, but every drop is counted — the
@@ -961,7 +1013,8 @@ class Learner:
             self.num_episodes += 1
             self.num_returned_episodes += 1
             if self.num_returned_episodes % 100 == 0:
-                print(self.num_returned_episodes, end=' ', flush=True)
+                _LOG.debug('returned %d episodes', self.num_returned_episodes)
+        telemetry.counter('learner_episodes_returned_total').inc(len(ks))
         return len(ks)
 
     def feed_results(self, results: List[Optional[dict]],
@@ -983,6 +1036,30 @@ class Learner:
                 n, r, r2 = opp_map.get(opponent, (0, 0, 0))
                 opp_map[opponent] = (n + 1, r + res, r2 + res ** 2)
 
+    # -- telemetry plumbing ----------------------------------------------
+    def _telemetry_snapshots(self) -> List[dict]:
+        """Exporter collector: live local registry + the latest merged
+        fleet snapshot (tagged source="fleet" to keep keys disjoint)."""
+        telemetry.gauge('learner_epoch').set(self.model_epoch)
+        telemetry.gauge('learner_buffer_episodes').set(
+            len(self.trainer.episodes))
+        telemetry.gauge('learner_sgd_steps_per_sec').set(
+            self.trainer.last_steps_per_sec)
+        snaps = [telemetry.snapshot()]
+        fleet = self._last_fleet_telemetry
+        if fleet and fleet.get('peers'):
+            snaps.append(telemetry.relabel(fleet, source='fleet'))
+        return snaps
+
+    def _merge_fleet_telemetry(self) -> dict:
+        """Aggregate the registry snapshots that rode in on the latest
+        heartbeat per peer (gathers pre-merge their workers' snapshots)."""
+        peers = self.worker.peer_info().values() if self.worker else ()
+        merged = telemetry.merge_snapshots(
+            [p.get('telemetry') for p in peers if isinstance(p, dict)])
+        self._last_fleet_telemetry = merged
+        return merged
+
     # -- epoch boundary ---------------------------------------------------
     def update(self):
         print()
@@ -990,9 +1067,10 @@ class Learner:
         self._print_eval_stats()
         self._print_generation_stats()
 
-        params, steps, state_blob = self.trainer.update()
+        with telemetry.span('epoch_update'):
+            params, steps, state_blob = self.trainer.update()
         if params is None and self.trainer.failed:
-            print('training failed (see traceback above); shutting down')
+            _LOG.error('training failed (see traceback above); shutting down')
             self.shutdown_flag = True
             return
         if params is None:
@@ -1006,6 +1084,7 @@ class Learner:
             return
         rec = {'epoch': self.model_epoch, 'steps': steps,
                'episodes': self.num_returned_episodes, 'time': time.time(),
+               'run_id': telemetry.run_id(),
                'sgd_steps_per_sec': round(self.trainer.last_steps_per_sec, 3),
                'buffer': len(self.trainer.episodes)}
         if extra:
@@ -1040,8 +1119,19 @@ class Learner:
             rec.update({'fleet_' + k: v
                         for k, v in self._fleet_snapshot().items()
                         if k != 'disconnects'})
-        with open(self._metrics_path, 'a') as f:
-            f.write(json.dumps(rec) + '\n')
+        # unified telemetry: the learner's own registry plus the merged
+        # per-peer snapshots that rode in on heartbeat frames (worker-mode
+        # runs), histograms reduced to count/sum/p50/p95/p99
+        telemetry.gauge('learner_epoch').set(self.model_epoch)
+        telemetry.gauge('learner_buffer_episodes').set(
+            len(self.trainer.episodes))
+        rec['telemetry'] = telemetry.summarize(telemetry.snapshot())
+        if self.worker is not None:
+            rec['fleet_telemetry'] = telemetry.summarize(
+                self._merge_fleet_telemetry())
+        # append-safe single-write line + fsync: a killed learner can never
+        # leave a torn half-line that breaks downstream JSONL parsing
+        append_jsonl(self._metrics_path, rec)
 
     def _run_eval_share(self, evaluator, tracker: Dict[str, int]):
         """Advance online evaluation until its share of episodes reaches
@@ -1086,8 +1176,8 @@ class Learner:
             from .environment import make_jax_env
             env_mod = make_jax_env(env_args)
             if env_mod is None:
-                print('no pure-JAX twin for %s; falling back to host envs'
-                      % env_args['env'])
+                _LOG.warning('no pure-JAX twin for %s; falling back to '
+                             'host envs', env_args['env'])
 
         # device-ingest layout (when the env/config allows assembling
         # training windows on device, ops/device_windows.py). On a
@@ -1338,6 +1428,9 @@ class Learner:
         # feed_device_chunk is one fetch behind dispatch; chunk -> epoch
         # attribution therefore uses the epoch captured at dispatch time
         epoch_of_dispatch = deque()
+        # fused dispatch latency joins the same 'compute' stage histogram
+        # the threaded trainer's StageTimer mirror feeds
+        m_dispatch = telemetry.histogram('stage_seconds', stage='compute')
 
         def account(prev):
             if prev is None:
@@ -1391,6 +1484,7 @@ class Learner:
                     actor.params, tr.state, tr.data_cnt_ema)
                 t1 = time.time()
                 tacc['dispatch'] += t1 - t0
+                m_dispatch.observe(t1 - t0)
                 tr.steps += fp.sgd_steps
                 epoch_steps += fp.sgd_steps
                 account(prev)
@@ -1528,7 +1622,7 @@ class Learner:
         and budgeted runs cannot hang waiting for episodes a dead host will
         never deliver. Duplicate uploads (a gather resending an un-acked
         RPC after reconnect) are dropped by the same book."""
-        print('started server')
+        _LOG.info('started server')
         cadence = _EpochCadence(self.args)
         ft = self.args.get('fault_tolerance') or {}
         ledger = self.ledger = TaskLedger(
@@ -1540,8 +1634,8 @@ class Learner:
             for ep, reason, _t in self.worker.drain_detach_events():
                 lost = ledger.fail_endpoint(ep)
                 if lost:
-                    print('re-issuing %d task(s) from detached peer (%s)'
-                          % (lost, reason))
+                    _LOG.warning('re-issuing %d task(s) from detached '
+                                 'peer (%s)', lost, reason)
             ledger.reap()
             try:
                 conn, (req, data) = self.worker.recv(timeout=0.3)
@@ -1624,7 +1718,7 @@ class Learner:
                 self._print_fleet_stats()
                 if self._past_epoch_budget():
                     self.shutdown_flag = True
-        print('finished server')
+        _LOG.info('finished server')
 
     def _fleet_snapshot(self) -> Dict[str, Any]:
         """Aggregate fleet health: server-side ledger + hub counters plus
@@ -1673,7 +1767,10 @@ class Learner:
         if self._trainer_thread is not None:
             self._trainer_thread.join(timeout=300)
             if self._trainer_thread.is_alive():
-                print('warning: trainer thread still running at shutdown')
+                _LOG.warning('trainer thread still running at shutdown')
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
     def run(self):
         self._trainer_thread = threading.Thread(target=self.trainer.run,

@@ -10,6 +10,7 @@ a reader sees either the old bytes or the new bytes, never a prefix.
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 
@@ -33,3 +34,20 @@ def atomic_write_bytes(path: str, data: bytes):
         except OSError:
             pass
         raise
+
+
+def append_jsonl(path: str, record: dict):
+    """Append ``record`` to a JSONL file append-safely.
+
+    The whole encoded line (payload + newline) goes down in ONE
+    ``os.write`` on an ``O_APPEND`` descriptor and is fsynced before the
+    descriptor closes — so a learner killed mid-epoch leaves either the
+    complete line or no line, never a torn half-line that breaks every
+    downstream JSONL parse of the metrics file."""
+    line = (json.dumps(record) + '\n').encode('utf-8')
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line)
+        os.fsync(fd)
+    finally:
+        os.close(fd)

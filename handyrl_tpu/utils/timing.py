@@ -10,7 +10,8 @@ accumulates wall seconds and event counts per named stage from any thread
 the breakdown — the same stage names ``BENCH_MODE=ingest`` (bench.py)
 reports, so a bench row and a live-run epoch line are directly comparable.
 
-Canonical stage names for the ingest path:
+Canonical stage names for the ingest path (telemetry.INGEST_STAGES is the
+one authoritative tuple):
   select / decode / assemble / ipc / h2d / compute / drain
 """
 
@@ -27,17 +28,25 @@ class StageTimer:
 
     ``add`` is cheap (one lock acquisition); the timed sections themselves
     run unlocked, so batcher threads never serialize on the timer.
+
+    ``registry`` (a telemetry.MetricRegistry) mirrors every ``add`` into
+    the ``stage_seconds{stage=...}`` span-histogram family, so the same
+    measurements that feed the per-epoch timing line and the ingest bench
+    also feed the fleet-wide telemetry/exporter view.
     """
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
         self._acc: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
+        self._registry = registry
 
     def add(self, stage: str, seconds: float, count: int = 1):
         with self._lock:
             self._acc[stage] = self._acc.get(stage, 0.0) + seconds
             self._n[stage] = self._n.get(stage, 0) + count
+        if self._registry is not None:
+            self._registry.observe_stage(stage, seconds, count)
 
     @contextmanager
     def section(self, stage: str):

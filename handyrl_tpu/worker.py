@@ -30,6 +30,7 @@ from collections import OrderedDict, defaultdict, deque
 from socket import gethostname
 from typing import Any, Dict, Optional
 
+from . import telemetry
 from .connection import (HEARTBEAT_KIND, Hub, accept_socket_connections,
                          connect_socket_connection, force_cpu_backend,
                          send_recv, spawn_pipe_workers)
@@ -38,6 +39,8 @@ from .evaluation import Evaluator
 from .fault import Backoff, parse_chaos
 from .generation import Generator
 from .model import ModelWrapper, RandomModel
+
+_LOG = telemetry.get_logger('worker')
 
 # Overridable so several learner/worker fleets (or parallel test runs) can
 # share one host without colliding on the well-known ports.
@@ -102,11 +105,17 @@ class Worker:
     plays out generation ('g') or evaluation ('e') assignments."""
 
     def __init__(self, args: Dict[str, Any], conn, wid: int):
-        print('opened worker %d' % wid)
+        _LOG.info('opened worker %d', wid)
+        telemetry.set_run_id(args.get('run_id'))
         self.worker_id = wid
         self.conn = conn
         self.env = make_env({**args['env'], 'id': wid})
         random.seed(args['seed'] + wid)
+        # one-way liveness/telemetry beacon cadence toward the gather: the
+        # snapshot rides the same HEARTBEAT frame the Hub already filters
+        ft = args.get('fault_tolerance') or {}
+        self._hb_interval = float(ft.get('heartbeat_interval', 10.0))
+        self._hb_next = time.time() + self._hb_interval
 
         self.env.reset()
         example_obs = self.env.observation(self.env.players()[0])
@@ -120,7 +129,19 @@ class Worker:
                          'e': (evaluator.execute, 'result')}
 
     def __del__(self):
-        print('closed worker %d' % self.worker_id)
+        _LOG.info('closed worker %d', self.worker_id)
+
+    def _maybe_heartbeat(self):
+        """Piggyback this worker's registry snapshot on a heartbeat frame
+        toward the gather's hub (filtered there into peer_info, merged into
+        the gather's own beacon toward the learner)."""
+        now = time.time()
+        if self._hb_interval <= 0 or now < self._hb_next:
+            return
+        self._hb_next = now + self._hb_interval
+        self.conn.send((HEARTBEAT_KIND,
+                        {'worker': self.worker_id,
+                         'telemetry': telemetry.snapshot()}))
 
     def run(self):
         """Supervised task loop: a broken pipe to the gather ends the
@@ -140,13 +161,16 @@ class Worker:
                       flush=True)
                 os._exit(17)
             try:
+                self._maybe_heartbeat()
                 task = send_recv(self.conn, ('args', None))
             except _CONN_ERRORS:
-                print('worker %d: lost its gather; exiting' % self.worker_id)
+                _LOG.warning('worker %d: lost its gather; exiting',
+                             self.worker_id)
                 return
             if task is None:
                 return
             produce, upload_as = self.playbook[task['role']]
+            t0 = time.perf_counter()
             try:
                 models = self.vault.obtain(dict(task.get('model_id', {})))
                 payload = produce(models, task)
@@ -155,6 +179,12 @@ class Worker:
             except Exception:
                 traceback.print_exc()
                 payload = None
+                telemetry.counter('worker_task_failures_total').inc()
+            telemetry.counter('worker_tasks_total',
+                              role=task['role']).inc()
+            telemetry.REGISTRY.histogram(
+                'worker_task_seconds', role=task['role']).observe(
+                    time.perf_counter() - t0)
             try:
                 send_recv(self.conn, (upload_as, payload))
             except _CONN_ERRORS:
@@ -192,8 +222,25 @@ class Gather:
 
     def __init__(self, args: Dict[str, Any], server_conn, gather_id: int,
                  reconnect=None):
-        print('started gather %d' % gather_id)
+        _LOG.info('started gather %d', gather_id)
+        telemetry.set_run_id(args.get('run_id'))
         self.gather_id = gather_id
+        gid = str(gather_id)
+        self._m_uploads = {
+            'episode': telemetry.counter('gather_uploads_total',
+                                         gather=gid, kind='episode'),
+            'result': telemetry.counter('gather_uploads_total',
+                                        gather=gid, kind='result')}
+        self._m_retries = telemetry.counter('gather_rpc_retries_total',
+                                            gather=gid)
+        self._m_reconnects = telemetry.counter('gather_reconnects_total',
+                                               gather=gid)
+        self._m_dropped = telemetry.counter('gather_dropped_uploads_total',
+                                            gather=gid)
+        self._m_box_depth = telemetry.gauge('gather_upload_box_depth',
+                                            gather=gid)
+        self._m_eps_rate = telemetry.gauge('gather_episodes_per_sec',
+                                           gather=gid)
         ft = args.get('fault_tolerance') or {}
         self._reconnect_fn = reconnect
         self._rpc_timeout = float(ft.get('rpc_timeout', 120.0))
@@ -232,7 +279,7 @@ class Gather:
         self._upload_count = 0
 
     def __del__(self):
-        print('finished gather %d' % self.gather_id)
+        _LOG.info('finished gather %d', self.gather_id)
 
     # -- supervised server link --
 
@@ -242,22 +289,41 @@ class Gather:
     def _heartbeat_loop(self):
         """One-way liveness beacons, sent even while the main loop blocks
         inside a long RPC (e.g. the server is busy at an epoch boundary).
-        FramedConnection.send serializes with the RPC path internally."""
+        FramedConnection.send serializes with the RPC path internally.
+
+        Each beacon piggybacks this relay's telemetry: its own registry
+        merged with the latest snapshot every worker child sent up its
+        pipe, plus a freshly computed episodes/sec gauge — the learner
+        aggregates these per-peer payloads into the fleet view."""
+        last_n, last_t = 0, time.time()
         while True:
             time.sleep(self._hb_interval)
+            now = time.time()
+            n = self._m_uploads['episode'].value
+            self._m_eps_rate.set((n - last_n) / max(now - last_t, 1e-9))
+            last_n, last_t = n, now
+            # the beacon thread starts before the worker hub is built; the
+            # first beats may carry only the gather's own registry
+            hub = getattr(self, 'hub', None)
+            worker_snaps = [info.get('telemetry')
+                            for info in (hub.peer_info_snapshot().values()
+                                         if hub is not None else ())
+                            if isinstance(info, dict)]
+            snap = telemetry.merge_snapshots(
+                [telemetry.snapshot()] + worker_snaps)
             conn = self.server
             try:
                 conn.send((HEARTBEAT_KIND,
-                           {'gather': self.gather_id, **self.stats}))
+                           {'gather': self.gather_id, **self.stats,
+                            'telemetry': snap}))
             except Exception:
                 pass   # the RPC path owns failure handling and reconnect
 
     def _recover(self, exc: Exception):
         """Redial the data port with exponential backoff + jitter (the
         ``entry()`` retry pattern, hardened)."""
-        print('gather %d: server link lost (%s: %s); reconnecting'
-              % (self.gather_id, type(exc).__name__, str(exc)[:120]),
-              flush=True)
+        _LOG.warning('gather %d: server link lost (%s: %s); reconnecting',
+                     self.gather_id, type(exc).__name__, str(exc)[:120])
         try:
             self.server.close()
         except Exception:
@@ -274,8 +340,9 @@ class Gather:
             conn.sock.settimeout(self._rpc_timeout)
             self.server = conn
             self.stats['reconnects'] += 1
-            print('gather %d: reconnected to the server' % self.gather_id,
-                  flush=True)
+            self._m_reconnects.inc()
+            _LOG.warning('gather %d: reconnected to the server',
+                         self.gather_id)
             return
         raise ConnectionError(
             'gather %d: could not re-reach the server after %d tries (%s)'
@@ -291,6 +358,7 @@ class Gather:
             except _CONN_ERRORS as exc:
                 if self._reconnect_fn is None:   # pipe mode: not recoverable
                     raise
+                self._m_retries.inc()
                 self._recover(exc)
 
     # -- per-RPC handling --
@@ -315,6 +383,8 @@ class Gather:
     def _stash_upload(self, kind: str, payload):
         self._upload_box[kind].append(payload)
         self._upload_count += 1
+        if kind in self._m_uploads:
+            self._m_uploads[kind].inc()
         while self._upload_count > self._resend_max:
             # bounded resend buffer: under a long outage, keep the newest
             # uploads and count the sacrifice instead of growing forever
@@ -322,12 +392,14 @@ class Gather:
             self._upload_box[biggest].pop(0)
             self._upload_count -= 1
             self.stats['dropped_uploads'] += 1
+            self._m_dropped.inc()
         if self._upload_count >= self.block:
             for kind in list(self._upload_box):
                 self._server_rpc((kind, self._upload_box[kind]))
                 # acked: this kind's batch is safely booked server-side
                 del self._upload_box[kind]
             self._upload_count = sum(len(v) for v in self._upload_box.values())
+        self._m_box_depth.set(self._upload_count)
 
     def run(self):
         while self.hub.count() > 0:
@@ -413,10 +485,10 @@ class WorkerServer(WorkerCluster):
         self._next_base_wid = 0
 
     def _entry_loop(self):
-        print('started entry server %d' % self.ENTRY_PORT)
+        _LOG.info('started entry server %d', self.ENTRY_PORT)
         for conn in accept_socket_connections(port=self.ENTRY_PORT):
             host_args = conn.recv()
-            print('accepted connection from %s!' % host_args['address'])
+            _LOG.info('accepted connection from %s!', host_args['address'])
             host_args['base_worker_id'] = self._next_base_wid
             self._next_base_wid += host_args['num_parallel']
             merged = dict(self.args)
@@ -425,7 +497,7 @@ class WorkerServer(WorkerCluster):
             conn.close()
 
     def _data_loop(self):
-        print('started worker server %d' % self.WORKER_PORT)
+        _LOG.info('started worker server %d', self.WORKER_PORT)
         for conn in accept_socket_connections(port=self.WORKER_PORT):
             self.hub.attach(conn)
 
@@ -480,7 +552,12 @@ class RemoteWorkerCluster:
 
     def run(self):
         merged = entry(self.args)
-        print(merged)
+        telemetry.set_run_id(merged.get('run_id'))
+        _LOG.info('joined run %s as %s (base_worker_id %s, %s gathers)',
+                  merged.get('run_id', '?'), self.args['address'],
+                  merged['worker'].get('base_worker_id'),
+                  self.args['num_gathers'])
+        _LOG.debug('merged config: %r', merged)
         prepare_env(merged['env'])
 
         ctx = mp.get_context('spawn')
@@ -539,13 +616,13 @@ class RemoteWorkerCluster:
                     fails[i] += 1
                     if fails[i] > max_fails:
                         # likely the server is gone for good — stop churning
-                        print('gather %d: giving up after %d failed respawns'
-                              % (i, fails[i] - 1), flush=True)
+                        _LOG.error('gather %d: giving up after %d failed '
+                                   'respawns', i, fails[i] - 1)
                         del children[i]
                         continue
                     delay = backoffs[i].next_delay()
-                    print('gather %d died (exit %s); respawning in %.1fs'
-                          % (i, proc.exitcode, delay), flush=True)
+                    _LOG.warning('gather %d died (exit %s); respawning '
+                                 'in %.1fs', i, proc.exitcode, delay)
                     time.sleep(delay)
                     children[i] = spawn(i)
                     started_at[i] = time.time()

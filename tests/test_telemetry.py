@@ -1,0 +1,418 @@
+"""Unified telemetry: registry math, merge rules, heartbeat piggyback over a
+real Hub pair, Prometheus exposition, the append-safe JSONL sink, and (slow)
+the distributed learner+worker run whose metrics_jsonl carries the merged
+fleet aggregates the exporter also serves.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from handyrl_tpu import telemetry
+from handyrl_tpu.telemetry import (MetricRegistry, TelemetryExporter,
+                                   hist_quantile, merge_snapshots,
+                                   metric_key, relabel, render_prometheus,
+                                   split_key, summarize,
+                                   validate_metrics_line)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_counter_concurrent_increments():
+    reg = MetricRegistry()
+    c = reg.counter('requests_total', role='g')
+
+    def spin():
+        for _ in range(5000):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 40000
+    assert reg.snapshot()['counters']['requests_total{role="g"}'] == 40000
+
+
+def test_metric_handles_are_cached_and_labeled():
+    reg = MetricRegistry()
+    assert reg.counter('a_total', x=1) is reg.counter('a_total', x=1)
+    assert reg.counter('a_total', x=1) is not reg.counter('a_total', x=2)
+    assert metric_key('a_total', {'b': 2, 'a': 1}) == 'a_total{a="1",b="2"}'
+    assert split_key('a_total{a="1"}') == ('a_total', 'a="1"')
+    assert split_key('plain') == ('plain', '')
+
+
+def test_gauge_set_and_add():
+    reg = MetricRegistry()
+    g = reg.gauge('depth')
+    g.set(3)
+    g.add(2)
+    assert reg.snapshot()['gauges']['depth'] == 5.0
+
+
+def test_histogram_buckets_and_percentiles():
+    reg = MetricRegistry()
+    h = reg.histogram('lat_seconds', buckets=(0.01, 0.1, 1.0), stage='x')
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = reg.snapshot()['hists']['lat_seconds{stage="x"}']
+    assert snap['buckets'] == [2, 1, 1, 1]     # one overflow bucket
+    assert snap['count'] == 5
+    assert abs(snap['sum'] - 5.56) < 1e-9
+    # p50: rank 2.5 inside the first bucket (2 events, bounds 0..0.01)
+    assert 0.0 < h.quantile(0.5) <= 0.1
+    # p99 lands in the overflow bucket -> clamped to the last bound
+    assert h.quantile(0.99) == 1.0
+    # empty histogram quantile is defined
+    assert hist_quantile((1.0,), [0, 0], 0, 0.5) == 0.0
+
+
+def test_histogram_observe_agg_matches_sums():
+    reg = MetricRegistry()
+    h = reg.histogram('stage_seconds', stage='decode')
+    h.observe_agg(0.5, 10)                      # 10 events, 50ms mean
+    assert h.count == 10
+    assert abs(h.sum - 0.5) < 1e-12
+
+
+def test_snapshot_reset_semantics():
+    reg = MetricRegistry()
+    reg.counter('c_total').inc(7)
+    reg.gauge('g').set(4)
+    reg.histogram('h_seconds').observe(0.2)
+    first = reg.snapshot(reset=True)
+    assert first['counters']['c_total'] == 7
+    second = reg.snapshot()
+    assert second['counters']['c_total'] == 0   # counters restart
+    assert second['hists']['h_seconds']['count'] == 0
+    assert second['gauges']['g'] == 4.0         # gauges are levels, kept
+
+
+def test_disabled_registry_is_inert(monkeypatch):
+    monkeypatch.setattr(telemetry, '_ENABLED', False)
+    reg = MetricRegistry()
+    reg.counter('c_total').inc(5)
+    reg.gauge('g').set(1)
+    reg.histogram('h').observe(1.0)
+    snap = reg.snapshot()
+    assert snap['counters']['c_total'] == 0
+    assert snap['gauges']['g'] == 0.0
+    assert snap['hists']['h']['count'] == 0
+
+
+def test_span_records_stage_histogram():
+    reg = MetricRegistry()
+    with reg.span('select'):
+        time.sleep(0.01)
+    with reg.span('decode', parent='select'):
+        pass
+    snap = reg.snapshot()
+    h = snap['hists']['stage_seconds{stage="select"}']
+    assert h['count'] == 1 and h['sum'] >= 0.01
+    assert 'stage_seconds{parent="select",stage="decode"}' in snap['hists']
+
+
+def test_stage_timer_mirrors_into_registry():
+    from handyrl_tpu.utils.timing import StageTimer
+    reg = MetricRegistry()
+    timer = StageTimer(registry=reg)
+    timer.add('assemble', 0.25, count=5)
+    assert timer.snapshot()['assemble'] == {'s': 0.25, 'n': 5}
+    h = reg.snapshot()['hists']['stage_seconds{stage="assemble"}']
+    assert h['count'] == 5 and abs(h['sum'] - 0.25) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# merge rules
+
+
+def _snap(counters=None, gauges=None, hists=None):
+    return {'run_id': 'x', 'time': 0.0, 'counters': counters or {},
+            'gauges': gauges or {}, 'hists': hists or {}}
+
+
+def test_merge_counters_sum_gauges_sum_hists_add():
+    h = {'bounds': [0.1, 1.0], 'buckets': [1, 2, 0], 'sum': 1.5, 'count': 3}
+    a = _snap({'c_total': 2}, {'depth{gather="0"}': 3.0}, {'lat': dict(h)})
+    b = _snap({'c_total': 5}, {'depth{gather="1"}': 4.0}, {'lat': dict(h)})
+    merged = merge_snapshots([a, b, None, 'garbage'])
+    assert merged['peers'] == 2                 # non-dicts skipped
+    assert merged['counters']['c_total'] == 7
+    # distinct label sets stay distinct (per-gather resolution survives)
+    assert merged['gauges'] == {'depth{gather="0"}': 3.0,
+                                'depth{gather="1"}': 4.0}
+    assert merged['hists']['lat']['buckets'] == [2, 4, 0]
+    assert merged['hists']['lat']['count'] == 6
+
+
+def test_merge_skips_mismatched_bucket_bounds():
+    a = _snap(hists={'lat': {'bounds': [0.1], 'buckets': [1, 0],
+                             'sum': 0.05, 'count': 1}})
+    b = _snap(hists={'lat': {'bounds': [0.2], 'buckets': [3, 0],
+                             'sum': 0.3, 'count': 3}})
+    merged = merge_snapshots([a, b])
+    assert merged['hists']['lat']['count'] == 1   # peer with other bounds skipped
+
+
+def test_summarize_reduces_histograms():
+    h = {'bounds': [0.1, 1.0], 'buckets': [8, 1, 1], 'sum': 2.0, 'count': 10}
+    out = summarize(_snap({'c_total': 1}, {'g': 2.0}, {'lat': h}))
+    assert out['counters'] == {'c_total': 1}
+    assert set(out['hists']['lat']) == {'count', 'sum', 'p50', 'p95', 'p99'}
+    assert out['hists']['lat']['count'] == 10
+
+
+# ---------------------------------------------------------------------------
+# heartbeat piggyback through a real Hub pair
+
+
+def test_heartbeat_piggyback_roundtrip_through_hub():
+    """A worker/gather registry snapshot must survive the msgpack wire codec
+    inside a heartbeat frame and come back out of peer_info ready to merge —
+    exactly the path worker -> gather -> learner telemetry rides."""
+    import socket
+    from handyrl_tpu.connection import FramedConnection, HEARTBEAT_KIND, Hub
+
+    reg = MetricRegistry()
+    reg.counter('gather_uploads_total', gather='3', kind='episode').inc(12)
+    reg.gauge('gather_episodes_per_sec', gather='3').set(2.5)
+    reg.histogram('worker_task_seconds', role='g').observe(0.05)
+    snap = reg.snapshot()
+
+    hub = Hub()
+    a, b = socket.socketpair()
+    server_side, client_side = FramedConnection(a), FramedConnection(b)
+    hub.attach(server_side)
+    client_side.send((HEARTBEAT_KIND,
+                      {'gather': 3, 'reconnects': 0, 'telemetry': snap}))
+    deadline = time.time() + 10
+    info = {}
+    while time.time() < deadline:
+        info = hub.peer_info_snapshot().get(server_side) or {}
+        if info:
+            break
+        time.sleep(0.05)
+    assert info.get('gather') == 3
+    merged = merge_snapshots([info.get('telemetry')])
+    key = 'gather_uploads_total{gather="3",kind="episode"}'
+    assert merged['counters'][key] == 12
+    assert merged['gauges']['gather_episodes_per_sec{gather="3"}'] == 2.5
+    assert merged['hists']['worker_task_seconds{role="g"}']['count'] == 1
+    hub.detach(server_side)
+    client_side.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + HTTP exporter
+
+
+_PROM_LINE = re.compile(
+    r'^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(_bucket|_sum|_count)?'
+    r'(\{[^{}]*\})? [0-9eE.+-]+)$')
+
+
+def assert_valid_exposition(body: str):
+    lines = [l for l in body.splitlines() if l.strip()]
+    assert lines, 'empty exposition'
+    for line in lines:
+        assert _PROM_LINE.match(line), 'bad exposition line: %r' % line
+
+
+def test_render_prometheus_format():
+    reg = MetricRegistry()
+    reg.counter('requests_total', role='g').inc(3)
+    reg.gauge('depth').set(1.5)
+    reg.histogram('lat_seconds', buckets=(0.1, 1.0)).observe(0.05)
+    body = render_prometheus([reg.snapshot()])
+    assert_valid_exposition(body)
+    assert '# TYPE requests_total counter' in body
+    assert 'requests_total{role="g"} 3' in body
+    assert 'depth 1.5' in body
+    # histogram: cumulative buckets + +Inf + sum/count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in body
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in body
+    assert 'lat_seconds_count 1' in body
+
+
+def test_exporter_serves_metrics_over_http():
+    reg = MetricRegistry()
+    reg.counter('pings_total').inc(2)
+    fleet = relabel(reg.snapshot(), source='fleet')
+    exporter = TelemetryExporter(
+        lambda: [reg.snapshot(), fleet], port=0).start()
+    try:
+        url = 'http://127.0.0.1:%d/metrics' % exporter.port
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert_valid_exposition(body)
+        assert 'pings_total 2' in body
+        assert 'pings_total{source="fleet"} 2' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                'http://127.0.0.1:%d/nope' % exporter.port, timeout=10)
+    finally:
+        exporter.stop()
+
+
+# ---------------------------------------------------------------------------
+# append-safe JSONL + schema checker
+
+
+def test_append_jsonl_writes_complete_lines(tmp_path):
+    from handyrl_tpu.utils.fs import append_jsonl
+    path = str(tmp_path / 'metrics.jsonl')
+    for i in range(3):
+        append_jsonl(path, {'epoch': i, 'v': 'x' * 100})
+    lines = open(path).read().splitlines()
+    assert [json.loads(l)['epoch'] for l in lines] == [0, 1, 2]
+
+
+def test_validate_metrics_line_schema():
+    good = json.dumps({'epoch': 1, 'steps': 10, 'episodes': 100,
+                       'time': 1.0, 'run_id': 'abc',
+                       'telemetry': {'counters': {}, 'gauges': {},
+                                     'hists': {}}})
+    rec = validate_metrics_line(good)
+    assert rec['epoch'] == 1
+    with pytest.raises(ValueError):
+        validate_metrics_line(json.dumps({'epoch': 1}))
+    with pytest.raises(ValueError):
+        validate_metrics_line(good, fleet=True)   # no fleet_telemetry key
+
+
+# ---------------------------------------------------------------------------
+# distributed e2e: fleet aggregation lands in metrics_jsonl + the exporter
+
+
+LEARNER_SCRIPT = r'''
+import os
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+def main():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.train import Learner
+    raw = {'env_args': {'env': 'TicTacToe'},
+           'train_args': {'batch_size': 8, 'update_episodes': 12,
+                          'minimum_episodes': 12, 'epochs': 2,
+                          'forward_steps': 8, 'num_batchers': 1,
+                          'model_dir': %(model_dir)r,
+                          'metrics_jsonl': %(metrics)r,
+                          'telemetry_port': %(port)d,
+                          'fault_tolerance': {'heartbeat_interval': 1.0,
+                                              'liveness_timeout': 15.0}}}
+    args = apply_defaults(raw)
+    learner = Learner(args=args, remote=True)
+    learner.run()
+    print('LEARNER DONE', learner.model_epoch, flush=True)
+
+if __name__ == '__main__':
+    main()
+'''
+
+WORKER_SCRIPT = r'''
+import os
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+def main():
+    from handyrl_tpu.worker import worker_main
+    args = {'worker_args': {'server_address': 'localhost', 'num_parallel': 2}}
+    worker_main(args, [])
+
+if __name__ == '__main__':
+    main()
+'''
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_distributed_run_exports_fleet_telemetry(tmp_path):
+    """Learner + worker host over real TCP: per-epoch metrics_jsonl records
+    must carry merged fleet telemetry (per-gather episodes/sec, upload
+    counters, queue depths) consistent with the per-process snapshots, and
+    the Prometheus endpoint must serve valid exposition text while the run
+    is live."""
+    entry_port, data_port, prom_port = 22910, 22911, 22912
+    model_dir = str(tmp_path / 'models')
+    metrics = str(tmp_path / 'metrics.jsonl')
+    learner_py = tmp_path / 'learner.py'
+    worker_py = tmp_path / 'worker.py'
+    learner_py.write_text(LEARNER_SCRIPT % {
+        'model_dir': model_dir, 'metrics': metrics, 'port': prom_port})
+    worker_py.write_text(WORKER_SCRIPT)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
+                'PYTHONPATH': repo + os.pathsep
+                + os.environ.get('PYTHONPATH', ''),
+                'HANDYRL_TPU_ENTRY_PORT': str(entry_port),
+                'HANDYRL_TPU_DATA_PORT': str(data_port)}
+
+    learner_log = open(tmp_path / 'learner.log', 'w')
+    worker_log = open(tmp_path / 'worker.log', 'w')
+    learner = subprocess.Popen([sys.executable, str(learner_py)],
+                               env=base_env, stdout=learner_log,
+                               stderr=subprocess.STDOUT)
+    worker = None
+    exposition = ''
+    try:
+        time.sleep(3)
+        worker = subprocess.Popen([sys.executable, str(worker_py)],
+                                  env=base_env, stdout=worker_log,
+                                  stderr=subprocess.STDOUT)
+        # scrape the exporter while the run is alive (retry until up)
+        deadline = time.time() + 240
+        url = 'http://127.0.0.1:%d/metrics' % prom_port
+        while time.time() < deadline and learner.poll() is None:
+            try:
+                exposition = urllib.request.urlopen(
+                    url, timeout=5).read().decode()
+                if 'source="fleet"' in exposition:
+                    break
+            except OSError:
+                pass
+            time.sleep(2)
+        learner.wait(timeout=300)
+        worker.wait(timeout=120)
+    finally:
+        for proc in (worker, learner):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        learner_log.close()
+        worker_log.close()
+
+    assert_valid_exposition(exposition)
+    assert 'source="fleet"' in exposition, \
+        'exporter never served merged fleet metrics'
+
+    lines = [l for l in open(metrics).read().splitlines() if l.strip()]
+    assert lines, 'no metrics_jsonl records written'
+    last = None
+    for line in lines:
+        last = validate_metrics_line(line, fleet=True)
+    fleet = last['fleet_telemetry']
+    # the acceptance trio: episodes/sec per gather (gauge), RPC retry
+    # counters, and upload/queue depth gauges, all merged from heartbeats
+    assert any(k.startswith('gather_episodes_per_sec')
+               for k in fleet['gauges']), fleet['gauges']
+    assert any(k.startswith('gather_upload_box_depth')
+               for k in fleet['gauges'])
+    assert any(k.startswith('gather_rpc_retries_total')
+               for k in fleet['counters'])
+    uploads = sum(v for k, v in fleet['counters'].items()
+                  if k.startswith('gather_uploads_total'))
+    assert uploads > 0
+    # fleet episode counters are plausible against the learner's own view
+    assert last['episodes'] >= 24
