@@ -34,7 +34,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'value_target': 'TD',         # 'VTRACE' 'TD' 'MC'
     'eval': {'opponent': ['random']},
     'seed': 0,
-    'restart_epoch': 0,
+    'restart_epoch': 0,           # resume from models/<n>.ckpt; -1 = auto-resume from the newest checkpoint that passes integrity verification (0 when none exists)
     'init_params': '',            # warm-start: load model params (a .ckpt snapshot of the SAME architecture) at epoch 0, fresh optimizer/episode counters — for measurement runs that need a late-stage policy (e.g. the replay-weighting A/B's long-episode regime)
     # --- TPU-native extensions (absent in the reference) ---
     'batched_generation': True,   # in-process vectorized self-play actors
@@ -67,6 +67,17 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'reconnect_max_tries': 30,     # redials before a gather gives up (and respawns before a gather slot is abandoned)
         'resend_buffer': 256,          # max unacked uploads a gather retains across reconnects; older ones are dropped + counted
     },
+
+    # learner-side crash/corruption resilience (guard.py,
+    # docs/large_scale_training.md "Preemption and recovery")
+    'guard': {
+        'nonfinite_policy': 'rollback',  # non-finite update handling: 'skip' (drop + count), 'rollback' (skip, then restore the last good checkpoint after rollback_after consecutive bad updates or a loss-spike trip), 'abort' (fail the run)
+        'rollback_after': 8,           # consecutive non-finite updates before an in-place rollback
+        'loss_spike_zscore': 0.0,      # >0: also roll back when the (finite) loss deviates this many EMA stddevs from its running mean; 0 disables
+        'check_episodes': True,        # drop (and count) incoming episodes whose decoded observations/rewards contain non-finite values before they reach the buffer
+        'preempt_signals': True,       # SIGTERM/SIGINT: flush a full checkpoint at the next safe point and exit 75 (supervisor contract: restart into restart_epoch -1)
+    },
+    'keep_checkpoints': 0,        # GC numbered models/<epoch>.ckpt beyond the newest N after each save (0 = keep all; league-opponent checkpoint paths are never deleted)
 
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
@@ -144,6 +155,19 @@ def validate(args: Dict[str, Any]) -> None:
         assert float(ft['liveness_timeout']) > float(ft['heartbeat_interval']), \
             'liveness_timeout must exceed heartbeat_interval or every ' \
             'healthy peer is detached between beacons'
+    assert int(ta.get('restart_epoch') or 0) >= -1, \
+        'restart_epoch must be >= -1 (-1 = auto-resume from the newest ' \
+        'valid checkpoint)'
+    assert int(ta.get('keep_checkpoints') or 0) >= 0, \
+        'keep_checkpoints must be >= 0 (0 keeps every checkpoint)'
+    g = ta.get('guard') or {}
+    assert str(g.get('nonfinite_policy', 'rollback')) in \
+        ('skip', 'rollback', 'abort'), \
+        "guard.nonfinite_policy must be 'skip', 'rollback' or 'abort'"
+    assert int(g.get('rollback_after', 8)) >= 1, \
+        'guard.rollback_after must be >= 1'
+    assert float(g.get('loss_spike_zscore', 0.0)) >= 0, \
+        'guard.loss_spike_zscore must be >= 0 (0 disables the trip)'
     if ta.get('telemetry_port') is not None:
         port = int(ta['telemetry_port'])
         assert 0 <= port <= 65535, \

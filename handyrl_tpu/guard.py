@@ -1,0 +1,293 @@
+"""Learner-side crash/corruption resilience.
+
+PR 2 made the *actor fleet* survive kills and severed sockets; this module
+hardens the learner itself — the remaining single fragile point on a
+preemptible TPU pod. Three legs, wired through train.py / utils/fs.py:
+
+* :class:`PreemptionGuard` — SIGTERM/SIGINT become a cooperative stop flag
+  the training loops check at safe points (batch boundary, epoch boundary).
+  On trigger the learner flushes a full atomic checkpoint (TrainState +
+  trainer_state + episode accounting), writes a final ``metrics_jsonl``
+  record tagged ``preempted``, tears down its children, and exits with
+  :data:`PREEMPT_EXIT_CODE` — the supervisor contract: *restart me, I will
+  resume* (``restart_epoch: -1`` auto-resolves the newest valid
+  checkpoint). A third signal is an operator override and kills the
+  process immediately with the conventional ``128 + signum``.
+
+* :class:`NonFiniteGuard` — escalation policy over the on-device all-finite
+  check the update step performs each SGD step (ops/train_step.py: a
+  non-finite loss, global grad norm, or lr leaves params/optimizer
+  untouched and raises the ``nonfinite`` metric). The host observes those
+  counts on its existing lazy metric fetch — no extra sync on the hot
+  path — and per ``guard.nonfinite_policy`` skips (count only), rolls the
+  TrainState back to the last good checkpoint after ``rollback_after``
+  consecutive bad updates (or a loss-spike z-score trip), or aborts.
+
+* Checkpoint integrity helpers — resume-time selection of the newest
+  numbered checkpoint that passes the CRC32 sidecar verification
+  (utils/fs.py), so a bit-flipped or truncated ``models/<epoch>.ckpt``
+  falls back to the previous valid epoch instead of crashing the restart.
+
+Chaos injectors (``HANDYRL_TPU_CHAOS``, parsed by fault.parse_chaos):
+``preempt=<s>`` SIGTERMs this process after a fixed delay; ``nanstep=<n>``
+/ ``nanepoch=<e>`` + ``nanburst=<k>`` poison the lr of ``k`` updates
+starting at global SGD step ``n`` (or right after epoch ``e``'s
+checkpoint), driving the skip/rollback machinery end to end.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import signal
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry
+from .fault import parse_chaos
+
+_LOG = telemetry.get_logger('guard')
+
+# EX_TEMPFAIL: the supervisor contract — a learner exiting with this code
+# snapshotted successfully and asks to be restarted into the resume path
+# (docs/large_scale_training.md "Preemption and recovery").
+PREEMPT_EXIT_CODE = 75
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT → cooperative stop flag (checked at safe points).
+
+    ``install`` is a no-op off the main thread (the CPython signal API
+    requirement) and when ``enabled`` is False; ``uninstall`` restores the
+    previous handlers so an in-process Learner (tests) leaves the host
+    interpreter's signal disposition untouched.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self.signum: Optional[int] = None
+        self._event = threading.Event()
+        self._count = 0
+        self._previous: Dict[int, Any] = {}
+
+    def install(self) -> 'PreemptionGuard':
+        if not self.enabled or self._previous:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._previous[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError):   # exotic embedding: stay passive
+                self._previous.pop(sig, None)
+                break
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._previous = {}
+
+    def _handle(self, signum, frame):
+        self._count += 1
+        self.signum = signum
+        self._event.set()
+        if self._count >= 3:
+            # operator insists: skip the graceful snapshot entirely
+            os._exit(128 + signum)
+
+    @property
+    def fired(self) -> bool:
+        return self._event.is_set()
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+
+class NonFiniteGuard:
+    """Host-side escalation policy over the device's per-update finiteness
+    flag. ``observe`` folds one drained metrics group in and returns the
+    action the trainer must take: None (clean), 'skip' (count and carry
+    on), 'rollback' (restore the last good checkpoint), 'abort'."""
+
+    def __init__(self, cfg: Optional[Dict[str, Any]] = None):
+        cfg = cfg or {}
+        self.policy = str(cfg.get('nonfinite_policy') or 'rollback')
+        self.rollback_after = max(1, int(cfg.get('rollback_after') or 8))
+        self.zscore = float(cfg.get('loss_spike_zscore') or 0.0)
+        self.consecutive = 0
+        self.total_bad = 0
+        self.rollbacks = 0
+        # EMA loss statistics for the optional spike trip
+        self._loss_mean = 0.0
+        self._loss_var = 0.0
+        self._loss_n = 0
+
+    def observe(self, bad: int, good: int,
+                loss_mean: Optional[float] = None) -> Optional[str]:
+        if bad:
+            self.total_bad += bad
+            self.consecutive += bad
+            if self.policy == 'abort':
+                return 'abort'
+            if (self.policy == 'rollback'
+                    and self.consecutive >= self.rollback_after):
+                return 'rollback'
+            return 'skip'
+        if good:
+            self.consecutive = 0
+            if loss_mean is not None and math.isfinite(loss_mean):
+                return self._observe_loss(loss_mean)
+        return None
+
+    def _observe_loss(self, loss: float) -> Optional[str]:
+        """EMA mean/variance z-score over per-drain loss means: a finite
+        but exploding loss trips the same rollback as a NaN burst. Needs
+        ``loss_spike_zscore`` > 0 and ~20 warmup samples."""
+        trip = None
+        if self.zscore > 0 and self._loss_n >= 20:
+            std = math.sqrt(max(self._loss_var, 1e-12))
+            if abs(loss - self._loss_mean) > self.zscore * std:
+                trip = 'rollback' if self.policy == 'rollback' else None
+                if trip:
+                    _LOG.warning('guard: loss spike %.4g (mean %.4g, '
+                                 'std %.4g) tripped the z-score guard',
+                                 loss, self._loss_mean, std)
+        self._loss_n += 1
+        alpha = 0.99
+        delta = loss - self._loss_mean
+        self._loss_mean += (1 - alpha) * delta
+        self._loss_var = alpha * (self._loss_var + (1 - alpha) * delta ** 2)
+        return trip
+
+    def reset_streak(self):
+        """Called after a rollback (or a rollback that had nowhere to go):
+        the restored state starts a fresh streak and fresh loss stats."""
+        self.consecutive = 0
+        self._loss_n = 0
+        self._loss_mean = 0.0
+        self._loss_var = 0.0
+
+
+class ChaosNaN:
+    """``nanstep``/``nanepoch``/``nanburst`` injection bookkeeping.
+
+    ``due(step, count)`` answers whether any of the ``count`` updates
+    dispatched starting at global SGD step ``step`` should be poisoned,
+    and CONSUMES the burst budget when it fires — a rollback that rewinds
+    the step counter back into the window must not re-trigger the
+    injection forever. ``nanepoch`` arms lazily (train.py arms it at the
+    matching epoch boundary, once a rollback target exists on disk).
+    """
+
+    def __init__(self, chaos: Optional[Dict[str, float]] = None):
+        chaos = parse_chaos() if chaos is None else chaos
+        self.at = int(chaos['nanstep']) if 'nanstep' in chaos else None
+        self.epoch = int(chaos['nanepoch']) if 'nanepoch' in chaos else None
+        self.burst = max(1, int(chaos.get('nanburst', 1)))
+        self.remaining = self.burst if (self.at is not None
+                                        or self.epoch is not None) else 0
+
+    def arm(self, at: int):
+        """Start (or restart) the injection window at step ``at``."""
+        if self.at is None:
+            self.at = int(at)
+
+    def due(self, step: int, count: int = 1) -> bool:
+        if self.at is None or self.remaining <= 0 or step + count <= self.at:
+            return False
+        self.remaining -= count
+        return True
+
+
+def arm_chaos_preempt(chaos: Optional[Dict[str, float]] = None):
+    """``HANDYRL_TPU_CHAOS=preempt=<s>``: SIGTERM this process after a
+    fixed delay — the test/soak stand-in for a TPU pod preemption notice."""
+    chaos = parse_chaos() if chaos is None else chaos
+    delay = chaos.get('preempt')
+    if not delay:
+        return None
+
+    def _fire():
+        print('chaos: preempting learner (SIGTERM)', flush=True)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    timer = threading.Timer(float(delay), _fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+# ---------------------------------------------------------------------------
+# checkpoint selection (integrity-verified resume / rollback targets)
+
+
+def numbered_checkpoints(model_dir: str) -> List[int]:
+    """Sorted epochs of the ``<epoch>.ckpt`` files present in model_dir."""
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        stem, dot, ext = name.partition('.')
+        if dot and ext == 'ckpt' and stem.isdigit():
+            out.append(int(stem))
+    return sorted(out)
+
+
+def newest_valid_epoch(model_dir: str, at_most: Optional[int] = None
+                       ) -> Tuple[int, List[int]]:
+    """Newest numbered checkpoint epoch passing CRC verification (0 when
+    none), plus the list of newer epochs that were discarded as invalid."""
+    from .utils.fs import verify_checkpoint
+    discarded: List[int] = []
+    for epoch in reversed(numbered_checkpoints(model_dir)):
+        if at_most is not None and epoch > at_most:
+            continue
+        ok, reason = verify_checkpoint(
+            os.path.join(model_dir, '%d.ckpt' % epoch))
+        if ok:
+            return epoch, discarded
+        _LOG.error('discarding checkpoint %d.ckpt: %s', epoch, reason)
+        discarded.append(epoch)
+    return 0, discarded
+
+
+# ---------------------------------------------------------------------------
+# episode ingest guard
+
+
+def _all_finite(x) -> bool:
+    if x is None:
+        return True
+    if isinstance(x, dict):
+        return all(_all_finite(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return all(_all_finite(v) for v in x)
+    arr = np.asarray(x)
+    if arr.dtype.kind not in 'fc':
+        return True
+    return bool(np.isfinite(arr).all())
+
+
+def episode_is_finite(episode: Dict[str, Any]) -> bool:
+    """True when the episode's outcome and decoded per-moment observations/
+    rewards/values/returns are all finite. Undecodable payloads count as
+    poisoned — one bad actor must not contaminate every future batch."""
+    try:
+        if not _all_finite(episode.get('outcome')):
+            return False
+        from .ops.batch import decompress_moments
+        for moment in decompress_moments(episode.get('moment') or []):
+            for key in ('observation', 'reward', 'value', 'return'):
+                if not _all_finite(moment.get(key)):
+                    return False
+    except Exception:
+        return False
+    return True

@@ -93,12 +93,32 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
                 # faithful BatchNorm; they differ in stat granularity
                 # (documented in PARITY.md).
                 new_bs = jax.lax.pmean(new_bs, axis_name)
+        # non-finite guard: one NaN/Inf gradient must not poison the
+        # TrainState forever. All-finite check on the (global) loss, grad
+        # norm and the runtime lr scalar, ON DEVICE — a bad step keeps the
+        # previous params/optimizer buffers and reports metrics as zeros
+        # plus nonfinite=1; the host reads that flag on its existing lazy
+        # metric fetch (no extra sync) and escalates per guard policy
+        # (guard.py: skip / rollback / abort).
+        ok = (jnp.isfinite(lr)
+              & jnp.isfinite(aux['losses']['total'])
+              & jnp.isfinite(optax.global_norm(grads)))
         updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(trainable, updates)
+
+        def keep(new, old):
+            return jnp.where(ok, new, old)
+        params = jax.tree_util.tree_map(keep, params, trainable)
+        opt_state = jax.tree_util.tree_map(keep, opt_state, state.opt_state)
         if new_bs is not None:
-            params = {**dict(params), 'batch_stats': new_bs}
+            params = {**dict(params),
+                      'batch_stats': jax.tree_util.tree_map(
+                          keep, new_bs, batch_stats)}
         metrics = {**aux['losses'], 'data_count': aux['data_count']}
+        metrics = {k: jnp.where(ok, v, jnp.zeros_like(v))
+                   for k, v in metrics.items()}
+        metrics['nonfinite'] = 1.0 - ok.astype(jnp.float32)
         new_state = TrainState(params=params, opt_state=opt_state,
                                steps=state.steps + 1)
         return new_state, metrics

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 import psutil
 
+from . import guard as guard_mod
 from . import telemetry
 from .environment import make_env, prepare_env
 from .fault import TaskLedger
@@ -54,7 +55,7 @@ from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
 from .utils.fetch import put_tree
-from .utils.fs import append_jsonl, atomic_write_bytes
+from .utils.fs import append_jsonl, checksummed_write_bytes
 from .worker import WorkerCluster, WorkerServer
 
 _LOG = telemetry.get_logger('train')
@@ -378,6 +379,17 @@ class Trainer:
         self._loss_sum: Dict[str, float] = {}
         self.shutdown_flag = False
         self.failed = False
+        self.started = False
+
+        # non-finite guard: the device update step skips bad steps in place
+        # (train_step.py); this side counts them and escalates per policy.
+        # rollback_source is installed by the Learner (it owns the
+        # checkpoint files); rollback_epoch hands the model-pool rewind
+        # back to the Learner's loop after an in-place state restore.
+        self.guard = guard_mod.NonFiniteGuard(args.get('guard') or {})
+        self.chaos_nan = guard_mod.ChaosNaN()
+        self.rollback_source = None
+        self.rollback_epoch: Optional[int] = None
 
         # throughput + profiling (the reference has no tracing at all —
         # SURVEY.md §5.1; here per-epoch step rate is tracked and a JAX
@@ -451,19 +463,24 @@ class Trainer:
         template = {'state': self.state, 'steps': self.steps,
                     'data_cnt_ema': self.data_cnt_ema}
         payload = serialization.from_bytes(template, raw)
-        self.state = jax.tree_util.tree_map(jnp.asarray, payload['state'])
-        if isinstance(self.state, tuple):
-            self.state = TrainState(*self.state)
+        # build everything before mutating: a parse/convert failure must
+        # leave the live state untouched (resume falls back instead)
+        state = jax.tree_util.tree_map(jnp.asarray, payload['state'])
+        if isinstance(state, tuple):
+            state = TrainState(*state)
+        self.state = state
         self.steps = int(payload['steps'])
         self.data_cnt_ema = float(payload['data_cnt_ema'])
 
-    def update(self):
+    def update(self, timeout: Optional[float] = None):
         """Called by the learner at each epoch boundary; blocks until the
         trainer hands over (params, steps, full-state blob). The blob is
         serialized inside the trainer loop — the state buffers are donated
-        to the next compiled step, so nobody may touch them afterwards."""
+        to the next compiled step, so nobody may touch them afterwards.
+        ``timeout`` (preemption flush) raises queue.Empty when the trainer
+        cannot reach a safe point in time."""
         self.update_flag = True
-        params, steps, state_blob = self.update_queue.get()
+        params, steps, state_blob = self.update_queue.get(timeout=timeout)
         return params, steps, state_blob
 
     def train(self):
@@ -557,10 +574,15 @@ class Trainer:
                             1, self.replay_stats['windows_ingested']):
                         time.sleep(0.05)
                         continue
+                ema = self.data_cnt_ema
+                if self.chaos_nan.due(self.steps, self.fused_steps):
+                    _LOG.warning('chaos: injecting non-finite update at '
+                                 'step %d', self.steps)
+                    ema = float('nan')   # poisons the on-device lr schedule
                 t_dispatch = time.perf_counter()
                 self.state, self._sample_key, metrics = self.replay_update(
                     self.state, buffers, self._sample_key, size, cursor,
-                    jnp.asarray(self.data_cnt_ema, jnp.float32))
+                    jnp.asarray(ema, jnp.float32))
                 timer.add('compute', time.perf_counter() - t_dispatch)
                 self.replay_stats['samples_drawn'] += (
                     self.args['batch_size'] * self.fused_steps)
@@ -584,7 +606,12 @@ class Trainer:
                 if not staged:
                     continue
             batch = staged.popleft()
-            lr = jnp.asarray(self._lr(), jnp.float32)
+            lr_val = self._lr()
+            if self.chaos_nan.due(self.steps):
+                _LOG.warning('chaos: injecting non-finite update at step %d',
+                             self.steps)
+                lr_val = float('nan')
+            lr = jnp.asarray(lr_val, jnp.float32)
             t_dispatch = time.perf_counter()
             self.state, metrics = self.update_step(self.state, batch, lr)
             timer.add('compute', time.perf_counter() - t_dispatch)
@@ -709,16 +736,67 @@ class Trainer:
     def _drain_metrics(self, pending: List[Dict[str, Any]]) -> int:
         """Fetch queued metric dicts in ONE packed transfer (per-scalar
         float() costs a tunnel round trip each) and fold them into the
-        epoch's loss sums. Returns the summed data_count."""
+        epoch's loss sums. Returns the summed data_count. The 'nonfinite'
+        skip counts ride the same fetch into the guard — escalation costs
+        no extra device sync."""
         from .utils.fetch import fetch_tree
         data_cnt = 0
+        bad = 0
+        total_sum = 0.0
         for m in fetch_tree(pending):
             for k, v in m.items():
                 if k == 'data_count':
                     data_cnt += int(v)
+                elif k == 'nonfinite':
+                    bad += int(v)
                 else:
+                    if k == 'total':
+                        total_sum += float(v)
                     self._loss_sum[k] = self._loss_sum.get(k, 0.0) + float(v)
+        per_dispatch = self.fused_steps if self.replay is not None else 1
+        n_updates = len(pending) * per_dispatch
+        self._guard_observe(bad, n_updates - bad,
+                            total_sum / data_cnt if data_cnt else None)
         return data_cnt
+
+    # -- non-finite guard --------------------------------------------------
+    def _guard_observe(self, bad: int, good: int,
+                       loss_mean: Optional[float] = None):
+        """Fold one drained metrics group into the guard; skip is counted,
+        rollback restores the last good checkpoint in place, abort raises
+        (the run()-level handler turns that into the failed path)."""
+        if bad:
+            telemetry.counter('guard_nonfinite_total').inc(bad)
+        action = self.guard.observe(bad, good, loss_mean)
+        if action == 'abort':
+            raise RuntimeError(
+                'guard: %d non-finite update(s) under nonfinite_policy='
+                'abort' % bad)
+        if action == 'rollback':
+            self._do_rollback()
+        elif bad:
+            _LOG.warning('guard: skipped %d non-finite update(s) '
+                         '(%d consecutive)', bad, self.guard.consecutive)
+
+    def _do_rollback(self):
+        """Restore the last good checkpoint IN PLACE (TrainState + step
+        counter + lr EMA) and hand the model-pool epoch rewind to the
+        Learner via ``rollback_epoch``. Safe here: called only between
+        dispatches, when self.state is a settled value."""
+        src = self.rollback_source() if self.rollback_source else None
+        if src is None:
+            _LOG.error('guard: rollback tripped but no valid checkpoint '
+                       'exists yet; continuing with skipped updates')
+            self.guard.reset_streak()
+            return
+        epoch, blob = src
+        self.load_state_bytes(blob)
+        self.guard.reset_streak()
+        self.guard.rollbacks += 1
+        self.rollback_epoch = epoch
+        telemetry.counter('guard_rollbacks_total').inc()
+        _LOG.error('guard: non-finite training burst — rolled back to '
+                   'checkpoint epoch %d (steps %d)', epoch, self.steps)
 
     def run(self):
         _LOG.info('waiting training')
@@ -735,6 +813,7 @@ class Trainer:
         if self.state is not None and not self.shutdown_flag:
             if self.replay is None:
                 self.batcher.run()
+            self.started = True
             _LOG.info('started training')
         while not self.shutdown_flag:
             try:
@@ -821,6 +900,19 @@ class Learner:
         self.shutdown_flag = False
         self.flags: set = set()
 
+        # learner-side resilience (guard.py): preemption snapshot-and-exit,
+        # episode ingest screening, checkpoint integrity/rollback plumbing
+        guard_args = dict(args.get('guard') or {})
+        self.preempt = guard_mod.PreemptionGuard(
+            enabled=bool(guard_args.get('preempt_signals', True)))
+        self._check_episodes = bool(guard_args.get('check_episodes', True))
+        self._bad_episodes = 0
+        self._chaos = guard_mod.parse_chaos()
+        self._final_flushed = False
+        self._fused_active = False
+        self._last_ckpt_epoch = -1
+        self._last_ckpt_steps = -1
+
         self.model_epoch = args['restart_epoch']
         module = net if net is not None else self.env.net()
         compute_dtype = args.get('compute_dtype')
@@ -832,9 +924,21 @@ class Learner:
         self._example_obs = self.env.observation(self.env.players()[0])
         self.wrapper.ensure_params(self._example_obs)
         self._resume = False
+        if self.model_epoch < 0:
+            # auto-resume (restart_epoch: -1): the supervisor restart path
+            # after a preemption exit — pick up the newest checkpoint that
+            # passes integrity verification, or start fresh when none does
+            self.model_epoch, discarded = guard_mod.newest_valid_epoch(
+                self.args.get('model_dir', 'models'))
+            args['restart_epoch'] = self.model_epoch
+            if discarded:
+                telemetry.counter('guard_ckpt_fallbacks_total').inc(
+                    len(discarded))
+            if self.model_epoch > 0:
+                print('auto-resume: newest valid checkpoint is epoch %d'
+                      % self.model_epoch)
         if self.model_epoch > 0:
-            with open(self.model_path(self.model_epoch), 'rb') as f:
-                self.wrapper.load_params_bytes(f.read(), self._example_obs)
+            self._load_resume_params()
             self._resume = True
         elif args.get('init_params'):
             # warm start: params only — epoch counter, optimizer moments and
@@ -875,12 +979,29 @@ class Learner:
             self.worker = WorkerServer(args) if remote else WorkerCluster(args)
 
         self.trainer = Trainer(args, self.wrapper)
+        self.trainer.rollback_source = self._rollback_source
         if self._resume:
             state_path = self.trainer_state_path()
             if os.path.exists(state_path):
-                with open(state_path, 'rb') as f:
-                    self.trainer.load_state_bytes(f.read())
-                print('resumed trainer state (steps %d)' % self.trainer.steps)
+                from .utils.fs import read_verified_bytes
+                raw = read_verified_bytes(state_path)
+                if raw is None:
+                    _LOG.error('discarding corrupt trainer_state.ckpt '
+                               '(checksum mismatch or truncation); the '
+                               'optimizer restarts fresh from the model '
+                               'checkpoint')
+                    telemetry.counter('guard_ckpt_fallbacks_total').inc()
+                else:
+                    try:
+                        self.trainer.load_state_bytes(raw)
+                        print('resumed trainer state (steps %d)'
+                              % self.trainer.steps)
+                    except Exception as exc:
+                        _LOG.error('discarding undecodable trainer_state'
+                                   '.ckpt (%s: %s); the optimizer restarts '
+                                   'fresh', type(exc).__name__,
+                                   str(exc)[:120])
+                        telemetry.counter('guard_ckpt_fallbacks_total').inc()
         self._trainer_thread: Optional[threading.Thread] = None
 
         # the scrape endpoint binds only once everything it reads (trainer,
@@ -923,24 +1044,233 @@ class Learner:
         print('updated model(%d)' % steps)
         if bump:
             self.model_epoch += 1
+            # chaos 'nanepoch': poison updates right after this epoch's
+            # checkpoint lands, so a rollback target provably exists
+            if self._chaos.get('nanepoch') == self.model_epoch:
+                self.trainer.chaos_nan.arm(self.trainer.steps + 1)
         if not write_files:
             return
         self._last_ckpt_epoch = self.model_epoch
+        self._last_ckpt_steps = steps
         # learner-side copy stays on HOST (numpy): it only feeds
         # snapshots/checkpoints; per-leaf device uploads each epoch
         # would pay a tunnel round trip per leaf
         self.wrapper.params = jax.tree_util.tree_map(np.asarray, params)
         os.makedirs(self.args.get('model_dir', 'models'), exist_ok=True)
         raw = self.wrapper.params_bytes()
-        # atomic (temp + fsync + rename): a crash mid-write must never leave
-        # a truncated latest.ckpt / trainer_state.ckpt for resume to load
+        # atomic (temp + fsync + rename) plus a CRC32 sidecar manifest: a
+        # crash mid-write must never leave a truncated latest.ckpt /
+        # trainer_state.ckpt, and resume verifies the checksum so silent
+        # on-disk corruption falls back instead of poisoning the restart
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
-            atomic_write_bytes(path, raw)
+            checksummed_write_bytes(path, raw)
         if state_blob is not None:
-            atomic_write_bytes(self.trainer_state_path(), state_blob)
+            checksummed_write_bytes(self.trainer_state_path(), state_blob)
+        self._gc_checkpoints()
+
+    # -- checkpoint integrity / retention / rollback -----------------------
+    def _load_resume_params(self):
+        """Load the resume params for ``self.model_epoch``, falling back to
+        the newest EARLIER checkpoint that both passes CRC verification and
+        deserializes, instead of crashing on corrupt/truncated bytes."""
+        from .utils.fs import verify_checkpoint
+        model_dir = self.args.get('model_dir', 'models')
+        candidates = [self.model_epoch] + [
+            e for e in reversed(guard_mod.numbered_checkpoints(model_dir))
+            if e < self.model_epoch]
+        for epoch in candidates:
+            path = self.model_path(epoch)
+            ok, reason = verify_checkpoint(path)
+            if not ok:
+                _LOG.error('discarding checkpoint %s: %s', path, reason)
+                telemetry.counter('guard_ckpt_fallbacks_total').inc()
+                continue
+            try:
+                with open(path, 'rb') as f:
+                    self.wrapper.load_params_bytes(f.read(), self._example_obs)
+            except Exception as exc:
+                _LOG.error('discarding undecodable checkpoint %s (%s: %s)',
+                           path, type(exc).__name__, str(exc)[:120])
+                telemetry.counter('guard_ckpt_fallbacks_total').inc()
+                continue
+            if epoch != self.model_epoch:
+                print('resume fell back to epoch %d (epoch %d checkpoint '
+                      'invalid)' % (epoch, self.model_epoch))
+                self.model_epoch = epoch
+                self.args['restart_epoch'] = epoch
+            return
+        raise FileNotFoundError(
+            'no loadable checkpoint at or below epoch %d in %s'
+            % (self.model_epoch, model_dir))
+
+    def _rollback_source(self):
+        """(epoch, trainer_state bytes) of the newest valid checkpoint pair
+        for the non-finite guard's in-place rollback; None before the first
+        checkpoint lands (the guard then stays in skip mode)."""
+        from .utils.fs import read_verified_bytes
+        blob = read_verified_bytes(self.trainer_state_path())
+        if blob is None:
+            return None
+        epoch, _discarded = guard_mod.newest_valid_epoch(
+            self.args.get('model_dir', 'models'))
+        if epoch <= 0:
+            return None
+        return epoch, blob
+
+    def _apply_rollback(self, epoch: int):
+        """The trainer restored its TrainState in place; rewind the
+        model-pool epoch and the actor-facing host params to match, so
+        subsequent checkpoints overwrite the poisoned trajectory."""
+        try:
+            with open(self.model_path(epoch), 'rb') as f:
+                self.wrapper.load_params_bytes(f.read(), self._example_obs)
+        except Exception as exc:
+            _LOG.error('rollback: could not reload epoch %d params (%s: %s)',
+                       epoch, type(exc).__name__, str(exc)[:120])
+        prev = self.model_epoch
+        self.model_epoch = min(self.model_epoch, epoch)
+        print('guard: rolled back to epoch %d (from epoch %d)'
+              % (self.model_epoch, prev))
+
+    def _fused_guard_observe(self, metrics: Dict[str, float], fp):
+        """Guard escalation for the fused loop (single-threaded: the
+        rollback happens inline, including the model-pool rewind)."""
+        tr = self.trainer
+        bad = int(metrics.get('nonfinite') or 0)
+        if bad:
+            telemetry.counter('guard_nonfinite_total').inc(bad)
+        cnt = int(metrics.get('data_count') or 0)
+        loss_mean = (float(metrics['total']) / cnt
+                     if cnt and 'total' in metrics else None)
+        action = tr.guard.observe(bad, max(0, fp.sgd_steps - bad), loss_mean)
+        if action == 'abort':
+            raise RuntimeError(
+                'guard: %d non-finite update(s) under nonfinite_policy='
+                'abort' % bad)
+        if action == 'skip':
+            _LOG.warning('guard: skipped %d non-finite update(s) '
+                         '(%d consecutive)', bad, tr.guard.consecutive)
+        if action != 'rollback':
+            return
+        src = self._rollback_source()
+        if src is None:
+            _LOG.error('guard: rollback tripped but no valid checkpoint '
+                       'exists yet; continuing with skipped updates')
+            tr.guard.reset_streak()
+            return
+        epoch, blob = src
+        tr.load_state_bytes(blob)
+        if tr.mesh is not None:
+            from .parallel.mesh import replicated_sharding
+            tr.state = jax.device_put(tr.state,
+                                      replicated_sharding(tr.mesh))
+        tr.guard.reset_streak()
+        tr.guard.rollbacks += 1
+        telemetry.counter('guard_rollbacks_total').inc()
+        _LOG.error('guard: non-finite training burst — rolled back to '
+                   'checkpoint epoch %d (steps %d)', epoch, tr.steps)
+        self._apply_rollback(epoch)
+
+    def _poll_rollback(self):
+        """Pick up a rollback the trainer thread performed since the last
+        loop iteration (threaded/replay trainers; the fused loop rolls back
+        inline)."""
+        epoch = self.trainer.rollback_epoch
+        if epoch is not None:
+            self.trainer.rollback_epoch = None
+            self._apply_rollback(epoch)
+
+    def _gc_checkpoints(self):
+        """``keep_checkpoints: N`` retention: drop numbered ckpts beyond
+        the newest N (plus their sidecars). League-opponent checkpoint
+        paths are never deleted; the rollback target (the newest valid
+        epoch) is always inside the kept window."""
+        keep = int(self.args.get('keep_checkpoints') or 0)
+        if keep <= 0:
+            return
+        from .utils.fs import sidecar_path
+        model_dir = self.args.get('model_dir', 'models')
+        epochs = guard_mod.numbered_checkpoints(model_dir)
+        if len(epochs) <= keep:
+            return
+        protected = {os.path.abspath(o)
+                     for o in (self.args.get('eval', {}).get('opponent') or [])
+                     if isinstance(o, str) and os.path.exists(o)}
+        for epoch in epochs[:-keep]:
+            path = self.model_path(epoch)
+            if os.path.abspath(path) in protected:
+                continue
+            for p in (path, sidecar_path(path)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            telemetry.counter('guard_ckpt_gc_total').inc()
+
+    def final_flush(self):
+        """ONE code path for the fused-loop tail flush and the preemption
+        snapshot: persist the current TrainState/params at most once, so a
+        SIGTERM landing during the final epoch cannot write
+        trainer_state.ckpt twice with different step counts."""
+        if self._final_flushed:
+            return
+        self._final_flushed = True
+        tr = self.trainer
+        params = steps = blob = None
+        if self._fused_active:
+            if tr.state is not None:
+                from .utils.fetch import fetch_tree
+                host_state = fetch_tree(tr.state)
+                params, steps = host_state.params, tr.steps
+                blob = tr.state_bytes(host_state)
+        elif (tr.started and tr.state is not None
+              and self._trainer_thread is not None
+              and self._trainer_thread.is_alive()):
+            # threaded/server modes: the trainer owns the state — force an
+            # epoch close and take the handover at the next batch boundary
+            try:
+                params, steps, blob = tr.update(timeout=60)
+            except queue.Empty:
+                _LOG.warning('flush: trainer did not reach a safe point in '
+                             'time; keeping the last epoch checkpoint')
+        if params is None:
+            return
+        if (self.model_epoch == self._last_ckpt_epoch
+                and steps == self._last_ckpt_steps):
+            return   # nothing advanced since the last write
+        self.update_model(params, steps, blob, bump=False)
+
+    def _write_preempt_record(self):
+        """Final metrics_jsonl record tagged ``preempted`` + the exit-code
+        contract line the supervisor greps for. Steps are the FLUSHED
+        count (what resume will restore), not the live trainer counter —
+        the JSONL step sequence stays monotonic across the restart."""
+        telemetry.counter('guard_preemptions_total').inc()
+        steps = max(self._last_ckpt_steps, 0)
+        self._write_metrics(steps, extra={
+            'preempted': True, 'signal': int(self.preempt.signum or 0)})
+        print('preempted: checkpoint flushed at epoch %d (steps %d); '
+              'exiting %d for a supervisor restart'
+              % (self.model_epoch, steps,
+                 guard_mod.PREEMPT_EXIT_CODE), flush=True)
 
     # -- accounting -------------------------------------------------------
     def feed_episodes(self, episodes: List[Optional[dict]]):
+        if self._check_episodes:
+            # ingest guard: one poisoned actor (NaN observations/rewards)
+            # must not contaminate every future batch — drop and count
+            # before anything enters the episode deque
+            clean: List[Optional[dict]] = []
+            for episode in episodes:
+                if (episode is not None
+                        and not guard_mod.episode_is_finite(episode)):
+                    self._bad_episodes += 1
+                    telemetry.counter('guard_bad_episodes_total').inc()
+                    _LOG.warning('guard: dropped episode with non-finite '
+                                 'data (%d total)', self._bad_episodes)
+                    continue
+                clean.append(episode)
+            episodes = clean
         for episode in episodes:
             if episode is None:
                 continue
@@ -1115,6 +1445,11 @@ class Learner:
                 self.trainer.ring_occupancy(), 4)
             rec['replay_sample_reuse'] = round(
                 stats['samples_drawn'] / max(1, stats['windows_ingested']), 3)
+        # guard health: cumulative skipped non-finite updates, in-place
+        # rollbacks, and dropped poisoned episodes (guard.py)
+        rec['guard_nonfinite'] = self.trainer.guard.total_bad
+        rec['guard_rollbacks'] = self.trainer.guard.rollbacks
+        rec['guard_bad_episodes'] = self._bad_episodes
         if getattr(self, 'ledger', None) is not None:
             rec.update({'fleet_' + k: v
                         for k, v in self._fleet_snapshot().items()
@@ -1337,6 +1672,11 @@ class Learner:
         while not self.shutdown_flag:
             if self._deadline and time.time() >= self._deadline:
                 break                      # wall-clock budget spent mid-epoch
+            if self.preempt.requested():
+                _LOG.warning('preemption signal received; snapshotting '
+                             'and exiting')
+                break
+            self._poll_rollback()
             if actor_epoch != self.model_epoch:   # follow latest epoch
                 actor.params = put_tree(self.wrapper.params)
                 actor_epoch = self.model_epoch
@@ -1350,7 +1690,7 @@ class Learner:
                 # different episodes together — backpressure generation
                 # instead (the trainer drains chunks even while it waits
                 # for minimum_episodes)
-                while not self.shutdown_flag:
+                while not self.shutdown_flag and not self.preempt.requested():
                     try:
                         self.trainer.chunk_queue.put(records, timeout=1.0)
                         break
@@ -1397,6 +1737,7 @@ class Learner:
         dispatch latency allows."""
         args = self.args
         tr = self.trainer
+        self._fused_active = True   # final_flush reads tr.state directly
         n_dev = len(tr.mesh.devices.flat) if tr.mesh is not None else 1
         print('fused device pipeline: rollout+ingest+train in one dispatch '
               '(%s mode%s)' % (mode, ', sharded over %d devices' % n_dev
@@ -1439,6 +1780,9 @@ class Learner:
                                    epoch_of_dispatch.popleft())
             if prev['metrics'] is not None:
                 pending_metrics.append(prev['metrics'])
+                # guard: the 'nonfinite' skip count is already a host
+                # float on the packed fetch — escalation costs no sync
+                self._fused_guard_observe(prev['metrics'], fp)
 
         # actor/eval params refresh DEVICE-to-device from the train state:
         # no host round trip, and correct even on epochs where
@@ -1462,6 +1806,10 @@ class Learner:
         while not self.shutdown_flag:
             if self._deadline and time.time() >= self._deadline:
                 break                      # wall-clock budget spent mid-epoch
+            if self.preempt.requested():
+                _LOG.warning('preemption signal received; snapshotting '
+                             'and exiting')
+                break
             if actor_epoch != self.model_epoch:
                 actor.params = (copy_params(tr.state.params)
                                 if tr.state is not None
@@ -1480,8 +1828,12 @@ class Learner:
                 account(fp.warm_step(actor.params))
                 tacc['fetch'] += time.time() - t0
             else:
-                tr.state, prev = fp.train_step(
-                    actor.params, tr.state, tr.data_cnt_ema)
+                ema = tr.data_cnt_ema
+                if tr.chaos_nan.due(tr.steps, fp.sgd_steps):
+                    _LOG.warning('chaos: injecting non-finite update at '
+                                 'step %d', tr.steps)
+                    ema = float('nan')   # poisons the on-device lr schedule
+                tr.state, prev = fp.train_step(actor.params, tr.state, ema)
                 t1 = time.time()
                 tacc['dispatch'] += t1 - t0
                 m_dispatch.observe(t1 - t0)
@@ -1512,14 +1864,11 @@ class Learner:
         if hasattr(evaluator, 'drain'):
             self.feed_results(evaluator.drain(),
                               model_id=eval_tracker.get('prev'))
-        if (tr.state is not None
-                and getattr(self, '_last_ckpt_epoch', 0) != self.model_epoch):
-            # checkpoint_interval skipped the file write for the last
-            # epoch(s); flush a final checkpoint so resume loses nothing
-            from .utils.fetch import fetch_tree
-            host_state = fetch_tree(tr.state)
-            self.update_model(host_state.params, tr.steps,
-                              tr.state_bytes(host_state), bump=False)
+        # checkpoint_interval may have skipped the last epoch's file write,
+        # and a preemption lands mid-epoch: one shared idempotent flush
+        # covers both (it also writes the preempt snapshot, so a SIGTERM
+        # during the final epoch cannot write trainer_state twice)
+        self.final_flush()
 
     def _fused_epoch(self, pending_metrics, epoch_steps, epoch_wall,
                      fp, evaluator):
@@ -1537,6 +1886,8 @@ class Learner:
             for k, v in metrics.items():
                 if k == 'data_count':
                     data_cnt += int(v)
+                elif k == 'nonfinite':
+                    continue   # guard counter, observed per chunk
                 else:
                     loss_sum[k] = loss_sum.get(k, 0.0) + float(v)
         if epoch_steps > 0:
@@ -1629,6 +1980,15 @@ class Learner:
             deadline=float(ft.get('task_deadline', 300.0)))
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
+            if self.preempt.requested():
+                # preemption: don't wait for the fleet to wind down — the
+                # snapshot happens in run()'s flush, gathers redial the
+                # restarted learner on their own (PR 2 supervision)
+                _LOG.warning('preemption signal received; snapshotting '
+                             'and exiting')
+                self.shutdown_flag = True
+                break
+            self._poll_rollback()
             # fleet supervision runs even when no RPC arrives: stranded
             # tasks must re-enter the queue or the epoch cadence starves
             for ep, reason, _t in self.worker.drain_detach_events():
@@ -1749,6 +2109,10 @@ class Learner:
         if getattr(self, 'ledger', None) is None:
             return
         snap = self._fleet_snapshot()
+        # learner-side guard health rides the same per-epoch line
+        snap['guard_nonfinite'] = self.trainer.guard.total_bad
+        snap['guard_rollbacks'] = self.trainer.guard.rollbacks
+        snap['guard_bad_episodes'] = self._bad_episodes
         reasons = snap.pop('disconnects', {})
         line = ' '.join('%s=%s' % kv for kv in snap.items())
         if reasons:
@@ -1771,8 +2135,13 @@ class Learner:
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
+        self.preempt.uninstall()
 
     def run(self):
+        # SIGTERM/SIGINT → cooperative snapshot-and-exit (safe points only);
+        # chaos 'preempt=<s>' arms a self-SIGTERM for the e2e tests
+        self.preempt.install()
+        guard_mod.arm_chaos_preempt(self._chaos)
         self._trainer_thread = threading.Thread(target=self.trainer.run,
                                                 daemon=True)
         self._trainer_thread.start()
@@ -1783,6 +2152,16 @@ class Learner:
                 self.worker.run()
                 self.server()
         finally:
+            if self.preempt.fired:
+                # flush the full checkpoint BEFORE tearing children down:
+                # the supervisor restart must find TrainState + trainer
+                # accounting exactly as of the last safe point
+                try:
+                    self.final_flush()
+                    self._write_preempt_record()
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
             self.shutdown()
 
 
@@ -1812,9 +2191,15 @@ def train_main(args):
     prepare_env(args['env_args'])
     learner = Learner(args=args)
     learner.run()
+    if learner.preempt.fired:
+        # supervisor contract: EX_TEMPFAIL asks for a restart into the
+        # resume path (restart_epoch: -1 auto-resolves the snapshot)
+        raise SystemExit(guard_mod.PREEMPT_EXIT_CODE)
 
 
 def train_server_main(args):
     _init_multihost(args)
     learner = Learner(args=args, remote=True)
     learner.run()
+    if learner.preempt.fired:
+        raise SystemExit(guard_mod.PREEMPT_EXIT_CODE)

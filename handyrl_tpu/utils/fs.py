@@ -13,6 +13,8 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
+import zlib
 
 
 def atomic_write_bytes(path: str, data: bytes):
@@ -34,6 +36,63 @@ def atomic_write_bytes(path: str, data: bytes):
         except OSError:
             pass
         raise
+
+
+def sidecar_path(path: str) -> str:
+    """The checksum manifest that rides next to a checkpoint file."""
+    return path + '.crc'
+
+
+def checksummed_write_bytes(path: str, data: bytes):
+    """Atomic write plus a CRC32 sidecar manifest (``<path>.crc``).
+
+    The manifest is a one-line JSON dict: ``{"algo": "crc32", "crc32": N,
+    "size": N, "time": T}``. The data file lands BEFORE the manifest: a
+    crash between the two publishes leaves a stale manifest that FAILS
+    verification, so resume conservatively falls back to an older epoch —
+    it never trusts a half-published pair.
+    """
+    atomic_write_bytes(path, data)
+    manifest = {'algo': 'crc32', 'crc32': zlib.crc32(data) & 0xffffffff,
+                'size': len(data), 'time': time.time()}
+    atomic_write_bytes(sidecar_path(path),
+                       (json.dumps(manifest) + '\n').encode('utf-8'))
+
+
+def _verify(path: str):
+    """(ok, reason, data-or-None). A missing sidecar reads as ok with
+    reason 'unverified' — checkpoints written before the manifest era (or
+    by external tools) stay loadable."""
+    try:
+        with open(path, 'rb') as f:
+            data = f.read()
+    except OSError as exc:
+        return False, 'unreadable (%s)' % exc, None
+    try:
+        with open(sidecar_path(path), 'r') as f:
+            manifest = json.load(f)
+    except OSError:
+        return True, 'unverified', data
+    except ValueError:
+        return False, 'manifest unparsable', None
+    if int(manifest.get('size', -1)) != len(data):
+        return False, 'size mismatch (truncated write?)', None
+    if int(manifest.get('crc32', -1)) != (zlib.crc32(data) & 0xffffffff):
+        return False, 'crc32 mismatch (corrupt bytes)', None
+    return True, 'ok', data
+
+
+def verify_checkpoint(path: str):
+    """(ok, reason) for ``path`` against its CRC32 sidecar manifest."""
+    ok, reason, _data = _verify(path)
+    return ok, reason
+
+
+def read_verified_bytes(path: str):
+    """The file's bytes, or None when it is missing, truncated, or fails
+    the sidecar checksum (legacy files without a sidecar pass)."""
+    ok, _reason, data = _verify(path)
+    return data if ok else None
 
 
 def append_jsonl(path: str, record: dict):
