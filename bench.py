@@ -46,6 +46,17 @@ INGEST_METRIC = ('host ingest batches/sec (GeeseNet B=128 T=16, '
                  'Batcher -> staged device buffer)')
 INGEST_UNIT = 'batches/sec'
 
+# BENCH_MODE=actor measures the distributed ACTOR data path: fleet
+# episodes/sec through a real gather + worker-process subtree speaking the
+# 4-RPC protocol, with the per-host batched InferenceEngine enabled
+# (inference.py) vs the per-worker B=1 reference path — identical seeds,
+# identical task stream, byte-compared episode records. vs_baseline is
+# engine-eps / per-worker-eps measured by the SAME harness.
+ACTOR_METRIC = ('fleet episodes/sec (HungryGeese/GeeseNet, gather+workers '
+                'over the 4-RPC protocol, engine-batched inference vs '
+                'per-worker B=1)')
+ACTOR_UNIT = 'episodes/sec'
+
 # Per-chip peaks by device_kind substring: (key, bf16 FLOP/s, HBM bytes/s).
 # Public figures: v4 275T & 1.23TB/s, v5e 197T & 819GB/s, v5p 459T &
 # 2.77TB/s, v6e 918T & 1.64TB/s.
@@ -78,8 +89,9 @@ def emit(value=0.0, vs_baseline=0.0, **extra):
     if _EMITTED:
         return
     _EMITTED = True
-    metric, unit = ((INGEST_METRIC, INGEST_UNIT)
-                    if _active_mode() == 'ingest' else (METRIC, UNIT))
+    metric, unit = {'ingest': (INGEST_METRIC, INGEST_UNIT),
+                    'actor': (ACTOR_METRIC, ACTOR_UNIT)}.get(
+                        _active_mode(), (METRIC, UNIT))
     line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
             'vs_baseline': round(float(vs_baseline), 2)}
     line.update(extra)
@@ -431,6 +443,152 @@ def run_ingest(probe: dict):
          geometry=('headline' if default_geom else 'dryrun'))
 
 
+def _actor_env() -> str:
+    return os.environ.get('BENCH_ACTOR_ENV', 'HungryGeese')
+
+
+def _actor_args(engine: bool, workers: int):
+    """Merged train_args for one bench fleet (the gather subtree's view)."""
+    from handyrl_tpu.config import apply_defaults
+    args = apply_defaults({'env_args': {'env': _actor_env()}})['train_args']
+    args['env'] = {'env': _actor_env()}
+    args['seed'] = 11
+    args['eval_rate'] = 0.0
+    args['worker'] = {'num_parallel': workers, 'num_gathers': 1,
+                      'base_worker_id': 0}
+    args['inference'] = dict(args['inference'],
+                             enabled=engine,
+                             batch_wait_ms=float(os.environ.get(
+                                 'BENCH_ACTOR_WAIT_MS', '2')))
+    return args
+
+
+def _actor_fleet_run(engine: bool, workers: int, total: int, warm: int,
+                     snapshot: dict, players: list) -> dict:
+    """Spawn ONE real gather (+ its worker processes) over a pipe and act as
+    its learner: serve 'g' tasks (each stamped with a deterministic
+    sample_key), the fixed model snapshot, and collect episode uploads.
+
+    Returns episodes/sec past the warmup, the packed episode payloads (for
+    byte-comparison across inference paths), and the gather's final
+    telemetry beacon (engine batch-fill counters ride it)."""
+    import time as _time
+    from handyrl_tpu.connection import (HEARTBEAT_KIND, pack,
+                                        spawn_pipe_workers)
+    from handyrl_tpu.worker import gather_loop
+
+    args = _actor_args(engine, workers)
+    ep = spawn_pipe_workers(1, gather_loop,
+                            lambda i, c: (args, c, i))[0]
+    served = 0
+    episodes, arrivals, failed = [], [], 0
+    beacon = {}
+    while True:
+        try:
+            kind, body = ep.recv()
+        except (EOFError, OSError):
+            break
+        if kind == HEARTBEAT_KIND:
+            beacon = body or {}
+            continue
+        if kind == 'args':
+            out = []
+            for _ in body:
+                if served < total:
+                    out.append({'role': 'g', 'player': list(players),
+                                'model_id': {p: 1 for p in players},
+                                'sample_key': served})
+                    served += 1
+                else:
+                    out.append(None)
+            ep.send(out)
+        elif kind == 'model':
+            ep.send(snapshot)
+        elif kind == 'episode':
+            now = _time.time()
+            for e in body:
+                if e is None:
+                    failed += 1
+                    continue
+                episodes.append(e)
+                arrivals.append(now)
+            ep.send(None)
+        elif kind == 'result':
+            ep.send(None)
+    measured = max(0, len(episodes) - warm)
+    span = (arrivals[-1] - arrivals[warm - 1]) if measured > 0 else 0.0
+    steps = sum(e['steps'] for e in episodes[warm:])
+    tele = (beacon.get('telemetry') or {}).get('counters') or {}
+    return {
+        'episodes_per_sec': measured / span if span > 0 else 0.0,
+        'requests_per_sec': steps / span if span > 0 else 0.0,
+        'records': sorted(pack(e) for e in episodes),
+        'failed': failed,
+        'engine_requests': tele.get('engine_requests_total', 0),
+        'engine_batches': tele.get('engine_batches_total', 0),
+    }
+
+
+def run_actor(probe: dict):
+    """BENCH_MODE=actor: the fleet actor data path, CPU-measurable.
+
+    Env knobs (CI smoke shrinks them): BENCH_ACTOR_WORKERS (default 4),
+    BENCH_ACTOR_EPISODES (timed episodes, default 96), BENCH_ACTOR_WARMUP
+    (default 16), BENCH_ACTOR_WAIT_MS (engine batch_wait_ms, default 2),
+    BENCH_ACTOR_ENV (default TicTacToe).
+    """
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
+    from handyrl_tpu import telemetry
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.model import ModelWrapper
+
+    workers = int(os.environ.get('BENCH_ACTOR_WORKERS', '6'))
+    warm = int(os.environ.get('BENCH_ACTOR_WARMUP', '4'))
+    total = warm + int(os.environ.get('BENCH_ACTOR_EPISODES', '12'))
+
+    # ONE fixed model snapshot (seeded params) served to both fleets: the
+    # record comparison needs both paths acting for the same policy
+    env = make_env({'env': _actor_env()})
+    env.reset()
+    wrapper = ModelWrapper(env.net(), seed=7)
+    wrapper.ensure_params(env.observation(env.players()[0]))
+    snapshot = wrapper.snapshot()
+    players = env.players()
+
+    import contextlib
+    with contextlib.redirect_stdout(sys.stderr):
+        # child-process startup prints must not break the one-line contract
+        base = _actor_fleet_run(False, workers, total, warm, snapshot,
+                                players)
+        eng = _actor_fleet_run(True, workers, total, warm, snapshot,
+                               players)
+
+    fill = eng['engine_requests'] / max(1, eng['engine_batches'])
+    emit(eng['episodes_per_sec'],
+         (eng['episodes_per_sec'] / base['episodes_per_sec'])
+         if base['episodes_per_sec'] else 0.0,
+         backend=probe.get('backend', 'unknown'),
+         device=probe.get('device_kind', 'unknown'),
+         workers=workers, episodes=total - warm, warmup=warm,
+         per_worker_episodes_per_sec=round(base['episodes_per_sec'], 2),
+         requests_per_sec=round(eng['requests_per_sec'], 2),
+         per_worker_requests_per_sec=round(base['requests_per_sec'], 2),
+         batch_fill=round(fill, 2),
+         records_identical=(eng['records'] == base['records']
+                            and len(eng['records']) == total),
+         failed_episodes=base['failed'] + eng['failed'],
+         vs_baseline_def=('engine episodes/sec / per-worker B=1 '
+                          'episodes/sec, identical harness, seeds and '
+                          'task stream'),
+         env=_actor_env(),
+         run_id=telemetry.run_id(),
+         geometry=('headline'
+                   if (workers >= 4 and total - warm >= 12
+                       and _actor_env() == 'HungryGeese')
+                   else 'dryrun'))
+
+
 def _last_measured() -> str:
     """The newest on-silicon bench-headline row, summarized for the
     backend-unavailable JSON line — so a wedged tunnel at the driver's
@@ -474,6 +632,8 @@ def main():
     try:
         if _active_mode() == 'ingest':
             run_ingest(probe)
+        elif _active_mode() == 'actor':
+            run_actor(probe)
         else:
             run_bench(probe)
     except Exception as exc:  # noqa: BLE001 — the contract is: always emit

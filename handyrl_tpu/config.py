@@ -19,6 +19,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'forward_steps': 16,
     'burn_in_steps': 0,
     'compress_steps': 4,
+    'compress_level': 9,          # bz2 compresslevel for episode moments (1 fastest .. 9 smallest); engine-mode workers are compression-dominated, so actor-starved hosts can trade upload bytes for episodes/sec
     'entropy_regularization': 1.0e-1,
     'entropy_regularization_decay': 0.1,
     'update_episodes': 200,
@@ -78,6 +79,18 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'preempt_signals': True,       # SIGTERM/SIGINT: flush a full checkpoint at the next safe point and exit 75 (supervisor contract: restart into restart_epoch -1)
     },
     'keep_checkpoints': 0,        # GC numbered models/<epoch>.ckpt beyond the newest N after each save (0 = keep all; league-opponent checkpoint paths are never deleted)
+
+    # per-host batched inference service for the distributed actor fleet
+    # (inference.py, docs/large_scale_training.md "Actor inference service"):
+    # workers become pure env-steppers; one engine per host coalesces their
+    # act/plan requests into batched forward passes
+    'inference': {
+        'enabled': False,        # route worker inference through the host engine
+        'batch_wait_ms': 2.0,    # coalescing deadline: how long the engine holds the oldest request while the batch fills (it dispatches early once every local worker has a request in flight)
+        'max_batch': 64,         # request cap per dispatched forward batch
+        'engine_backend': 'cpu',  # 'cpu' pins the engine to host cores; 'device' lets the engine claim a worker-host-local accelerator (never set on hosts sharing the learner's chip)
+        'vault_size': 3,         # materialized model snapshots cached (engine-side in engine mode, per worker otherwise)
+    },
 
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
@@ -175,6 +188,17 @@ def validate(args: Dict[str, Any]) -> None:
         assert port == 0 or ta.get('telemetry', True), \
             'telemetry_port needs telemetry enabled (the exporter serves ' \
             'the registry the collection switch turns off)'
+    assert 1 <= int(ta.get('compress_level', 9)) <= 9, \
+        'compress_level must be a bz2 compresslevel in 1..9'
+    inf = ta.get('inference') or {}
+    assert str(inf.get('engine_backend', 'cpu')) in ('cpu', 'device'), \
+        "inference.engine_backend must be 'cpu' or 'device'"
+    assert float(inf.get('batch_wait_ms', 2.0)) >= 0, \
+        'inference.batch_wait_ms must be >= 0 (0 = dispatch immediately)'
+    assert int(inf.get('max_batch', 64)) >= 1, \
+        'inference.max_batch must be >= 1'
+    assert int(inf.get('vault_size', 3)) >= 1, \
+        'inference.vault_size must be >= 1'
     if ta.get('batcher_shared_memory'):
         assert ta.get('batcher_processes'), \
             'batcher_shared_memory requires batcher_processes (the thread ' \

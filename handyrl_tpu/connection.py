@@ -284,6 +284,14 @@ _WRITER_EXIT = object()   # per-endpoint writer shutdown sentinel
 # reply would land in the middle of the client's call-response stream.
 HEARTBEAT_KIND = '__hb__'
 
+# Inference-service frames (inference.py): an engine-mode worker's
+# ``(INFER_KIND, request)`` rides its existing pipe to the host relay,
+# multiplexed by the relay's Hub event loop alongside the task RPCs; the
+# engine's reply is posted back through the same per-endpoint outbox. The
+# worker holds at most one request in flight, so the strict call-response
+# pairing of the 4-RPC protocol is preserved frame-for-frame.
+INFER_KIND = '__infer__'
+
 
 def is_heartbeat(msg) -> bool:
     return (isinstance(msg, (list, tuple)) and len(msg) == 2

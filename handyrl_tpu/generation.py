@@ -20,7 +20,8 @@ with per-step moment dicts of 7 per-player entries + the turn list.
 from __future__ import annotations
 
 import random
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -35,15 +36,124 @@ _EPISODES = telemetry.counter('episodes_generated_total')
 _STEPS = telemetry.counter('generation_steps_total')
 
 
-def _sample_action(policy: np.ndarray, legal_actions) -> tuple:
-    """Mask illegal logits with +1e32 penalty, softmax, sample.
+# ---------------------------------------------------------------------------
+# action sampling — the ONE audited routine shared by the per-worker B=1
+# path and the per-host InferenceEngine (inference.py). Sampling is keyed by
+# an explicit seed sequence instead of hidden process-global RNG state, so a
+# draw is a pure function of (seed sequence, policy, legal actions): the
+# engine can replay any worker's draw bit-identically regardless of how
+# requests interleave across the fleet.
+
+
+def sample_seed(base_seed, episode_key: Sequence[int], draw_index: int
+                ) -> List[int]:
+    """Deterministic per-draw seed sequence for np.random.default_rng.
+
+    ``episode_key`` identifies the episode (the server-stamped
+    ``sample_key``, or a worker-local fallback stream); ``draw_index``
+    counts action draws within the episode in play order."""
+    seq = (int(base_seed), *(int(k) for k in episode_key), int(draw_index))
+    return [k & 0xFFFFFFFFFFFFFFFF for k in seq]
+
+
+def masked_sample_batch(policies: np.ndarray, legal_lists, seed_seqs):
+    """Sample one action per row from the legality-masked softmax.
+
+    Vectorized over rows: the mask build and the softmax (the hot part) run
+    as single array ops; the draw itself is one inverse-CDF lookup per row
+    from that row's own seeded generator. Returns
+    ``(actions[int64], selected_probs[float32], action_masks[float32])``;
+    the mask rows use the reference's +1e32 illegal penalty so recorded
+    ``action_mask`` entries stay contract-identical.
+    """
+    policies = np.asarray(policies)
+    masks = np.full(policies.shape, 1e32, policies.dtype)
+    for n, legal in enumerate(legal_lists):
+        masks[n, list(legal)] = 0
+    probs = softmax(policies - masks)
+    actions = np.empty(len(legal_lists), np.int64)
+    selected = np.empty(len(legal_lists), policies.dtype)
+    for n, (legal, seq) in enumerate(zip(legal_lists, seed_seqs)):
+        legal = list(legal)
+        cum = np.cumsum(probs[n, legal], dtype=np.float64)
+        u = np.random.default_rng(seq).random() * cum[-1]
+        idx = min(int(np.searchsorted(cum, u, side='right')), len(legal) - 1)
+        actions[n] = legal[idx]
+        selected[n] = probs[n, legal[idx]]
+    return actions, selected, masks
+
+
+def masked_sample(policy: np.ndarray, legal_actions, seed_seq) -> tuple:
+    """B=1 view of :func:`masked_sample_batch`.
 
     Returns (action, prob_of_action, action_mask)."""
-    action_mask = np.ones_like(policy) * 1e32
-    action_mask[legal_actions] = 0
-    p = softmax(policy - action_mask)
-    action = random.choices(legal_actions, weights=p[legal_actions])[0]
-    return action, p[action], action_mask
+    actions, selected, masks = masked_sample_batch(
+        np.asarray(policy)[None], [legal_actions], [seed_seq])
+    return int(actions[0]), selected[0], masks[0]
+
+
+def bucketed_inference(model, obs, hidden=None) -> Dict[str, Any]:
+    """Single-sample forward through the power-of-two-bucket batched program.
+
+    XLA compiles a DIFFERENT program for a batch-1 input than for the padded
+    buckets the vectorized engines dispatch, and the two disagree in the
+    last float bit (row outputs across bucket sizes 8/16/... are
+    bit-identical to each other; only the B=1 program strays — and is
+    slower on CPU besides). Routing the sequential path through the same
+    bucketed program keeps per-worker episode records bit-identical to
+    engine-mode ones. Models without ``batch_inference`` (RandomModel, wire
+    proxies) fall back to their own ``inference``."""
+    batch = getattr(model, 'batch_inference', None)
+    if batch is None:
+        return model.inference(obs, hidden)
+    obs_b, _ = pad_to_bucket([obs])
+    hidden_b = None
+    if hidden is not None:
+        hidden_b, _ = pad_to_bucket([hidden])
+    outputs = batch(obs_b, hidden_b)
+    out = {}
+    for k, v in outputs.items():
+        if v is None:
+            continue
+        if k == 'hidden':
+            out[k] = map_structure(lambda a: np.asarray(a)[0], v)
+        else:
+            out[k] = np.asarray(v)[0]
+    return out
+
+
+def model_act(model, obs, hidden, legal_actions, seed_seq) -> Dict[str, Any]:
+    """One acting ply: forward pass + masked sample.
+
+    Engine-mode models (inference.RemoteModel) expose ``act`` and run both
+    halves server-side in a coalesced batch; everything else runs the local
+    bucketed forward and the same shared sampler."""
+    act = getattr(model, 'act', None)
+    if act is not None:
+        return act(obs, hidden, legal_actions, seed_seq)
+    outputs = bucketed_inference(model, obs, hidden)
+    action, prob, mask = masked_sample(outputs['policy'], legal_actions,
+                                       seed_seq)
+    return {'action': action, 'prob': prob, 'action_mask': mask,
+            'value': outputs.get('value'), 'hidden': outputs.get('hidden')}
+
+
+def pad_to_bucket(structures: list, min_bucket: int = 8):
+    """Stack a list of pytrees row-wise and pad the row count to a
+    power-of-two bucket (replicating row 0), so simultaneous games with
+    variable active-row counts trigger at most log2 recompiles.
+
+    Returns ``(padded_batch, true_rows)``."""
+    rows = len(structures)
+    bucket = max(min_bucket, 1 << (rows - 1).bit_length())
+    pad = bucket - rows
+
+    def pad_rows(x):
+        if pad == 0:
+            return x
+        return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
+
+    return map_structure(pad_rows, stack_structure(structures)), rows
 
 
 def _blank_moment(players) -> Dict[str, Dict[int, Any]]:
@@ -61,22 +171,60 @@ def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
             moments[i]['return'][player] = ret
     _EPISODES.inc()
     _STEPS.inc(len(moments))
+    # with engine-mode workers, bz2 compression is the dominant remaining
+    # worker-side cost: time it under the shared stage_seconds vocabulary
+    t0 = time.perf_counter()
+    blocks = compress_moments(moments, args['compress_steps'],
+                              level=args.get('compress_level', 9))
+    telemetry.REGISTRY.observe_stage('compress', time.perf_counter() - t0)
     return {
         'args': gen_args, 'steps': len(moments),
         'outcome': env.outcome(),
-        'moment': compress_moments(moments, args['compress_steps']),
+        'moment': blocks,
     }
 
 
 class Generator:
-    """Sequential single-env episode generator (reference-parity engine)."""
+    """Sequential single-env episode generator (reference-parity engine).
 
-    def __init__(self, env, args: Dict[str, Any]):
+    ``namespace`` (the worker id) keys the fallback sampling stream for
+    tasks without a server-stamped ``sample_key``, so parallel workers
+    never replay one another's draws. When the task does carry a
+    ``sample_key`` (train.py stamps every assignment), the episode is a
+    pure function of (seed, sample_key, model params) — identical whether
+    the draws run locally or on the host inference engine, and regardless
+    of which worker the task lands on.
+    """
+
+    def __init__(self, env, args: Dict[str, Any], namespace: int = 0):
         self.env = env
         self.args = args
+        self.namespace = int(namespace)
+        self._local_episodes = 0
+
+    @staticmethod
+    def _record_act(moment: dict, player, hidden: dict, res: Dict[str, Any]):
+        hidden[player] = res.get('hidden', None)
+        moment['value'][player] = res.get('value', None)
+        moment['selected_prob'][player] = res['prob']
+        moment['action_mask'][player] = res['action_mask']
+        moment['action'][player] = res['action']
 
     def generate(self, models: Dict[int, Any], gen_args: Dict[str, Any]
                  ) -> Optional[dict]:
+        base_seed = self.args.get('seed', 0)
+        skey = (gen_args or {}).get('sample_key')
+        episode_key = ((0, int(skey)) if skey is not None
+                       else (1, self.namespace, self._local_episodes))
+        self._local_episodes += 1
+        draws = 0
+        # envs with stochastic transitions keep a per-instance rng (e.g.
+        # HungryGeese spawns); reseeding it from the episode key makes the
+        # whole episode a pure function of (seed, sample_key, params) —
+        # replayable on any worker and on either inference path
+        env_rng = getattr(self.env, 'rng', None)
+        if isinstance(env_rng, random.Random):
+            env_rng.seed('episode:%d:%s' % (base_seed, (episode_key,)))
         moments: List[dict] = []
         hidden = {p: models[p].init_hidden() for p in self.env.players()}
         if self.env.reset():
@@ -87,25 +235,43 @@ class Generator:
             turn_players = self.env.turns()
             observers = self.env.observers()
 
-            for player in self.env.players():
-                if player not in turn_players + observers:
+            # acting plies first, SUBMIT-then-COLLECT: engine-mode models
+            # put every simultaneous-turn request on the wire before any
+            # reply is read, so a worker's whole ply coalesces into one
+
+            # engine batch instead of paying one round trip per seat
+            pending = []   # (player, model, request id)
+            for player in turn_players:
+                obs = self.env.observation(player)
+                moment['observation'][player] = obs
+                seed_seq = sample_seed(base_seed, episode_key, draws)
+                draws += 1
+                legal = self.env.legal_actions(player)
+                submit = getattr(models[player], 'act_send', None)
+                if submit is not None:
+                    pending.append((player, models[player],
+                                    submit(obs, hidden[player], legal,
+                                           seed_seq)))
+                else:
+                    self._record_act(
+                        moment, player, hidden,
+                        model_act(models[player], obs, hidden[player],
+                                  legal, seed_seq))
+            for player, model, rid in pending:
+                self._record_act(moment, player, hidden, model.act_recv(rid))
+
+            for player in observers:
+                if player in turn_players:
                     continue
-                if (player not in turn_players and player in gen_args['player']
+                if (player in gen_args['player']
                         and not self.args['observation']):
                     continue
-
                 obs = self.env.observation(player)
-                outputs = models[player].inference(obs, hidden[player])
+                outputs = bucketed_inference(models[player], obs,
+                                             hidden[player])
                 hidden[player] = outputs.get('hidden', None)
                 moment['observation'][player] = obs
                 moment['value'][player] = outputs.get('value', None)
-
-                if player in turn_players:
-                    action, prob, amask = _sample_action(
-                        outputs['policy'], self.env.legal_actions(player))
-                    moment['selected_prob'][player] = prob
-                    moment['action_mask'][player] = amask
-                    moment['action'][player] = action
 
             if self.env.step(moment['action']):
                 return None
@@ -168,23 +334,12 @@ class BatchedGenerator:
         if not jobs:
             return []
 
-        # pad the row count to a power-of-two bucket so simultaneous games
-        # (variable active-player counts) trigger at most log2 recompiles
-        rows = len(jobs)
-        bucket = max(8, 1 << (rows - 1).bit_length())
-        pad = bucket - rows
-
-        def pad_rows(x):
-            if pad == 0:
-                return x
-            return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
-
-        obs_batch = map_structure(pad_rows, stack_structure([j[3] for j in jobs]))
+        obs_batch, _ = pad_to_bucket([j[3] for j in jobs])
         use_hidden = any(self._hidden[i].get(p) is not None for i, p, _, _ in jobs)
         hidden_batch = None
         if use_hidden:
-            hidden_batch = map_structure(
-                pad_rows, stack_structure([self._hidden[i][p] for i, p, _, _ in jobs]))
+            hidden_batch, _ = pad_to_bucket(
+                [self._hidden[i][p] for i, p, _, _ in jobs])
         outputs = self.wrapper.batch_inference(obs_batch, hidden_batch)
         policies = np.asarray(outputs['policy'])
         values = np.asarray(outputs['value']) if 'value' in outputs else None
@@ -337,22 +492,12 @@ class BatchedEvaluator:
             return {}
         key = self._slot_state[jobs[0][0]]['model_seats'][jobs[0][1]]['key']
         model = self._model_pool[key]
-        rows = len(jobs)
-        bucket = max(8, 1 << (rows - 1).bit_length())
-        pad = bucket - rows
-
-        def pad_rows(x):
-            if pad == 0:
-                return x
-            return np.concatenate([x, np.repeat(x[:1], pad, axis=0)], axis=0)
-
-        obs_batch = map_structure(pad_rows, stack_structure(
-            [self.envs[i].observation(p) for i, p in jobs]))
+        obs_batch, _ = pad_to_bucket(
+            [self.envs[i].observation(p) for i, p in jobs])
         seats = [self._slot_state[i]['model_seats'][p] for i, p in jobs]
         hidden_batch = None
         if seats[0]['hidden'] is not None:
-            hidden_batch = map_structure(pad_rows, stack_structure(
-                [s['hidden'] for s in seats]))
+            hidden_batch, _ = pad_to_bucket([s['hidden'] for s in seats])
         outputs = model.batch_inference(obs_batch, hidden_batch)
         policies = np.asarray(outputs['policy'])
         next_hidden = outputs.get('hidden', None)

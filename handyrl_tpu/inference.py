@@ -1,0 +1,425 @@
+"""Per-host batched inference service for the distributed actor fleet.
+
+The reference design runs B=1 CPU inference inside every episode worker
+(reference model.py:50-60): each worker process holds a full model snapshot
+and pays one jitted dispatch per ply. The Podracer/Sebulba architecture
+(https://arxiv.org/pdf/2104.06272) restructures that: env-steppers submit
+observations to one accelerator-adjacent inference server that coalesces
+them into large batched forward passes. This module is that restructuring
+for the 4-RPC worker fleet:
+
+* :class:`InferenceEngine` — owned by the per-host relay (``worker.Gather``).
+  It is the only process on the host that materializes model snapshots
+  (model broadcast cost drops from O(workers) to O(hosts)); it coalesces
+  outstanding ``(model_id, obs, hidden, legal_actions)`` requests across all
+  workers on the host — per model id, under a ``batch_wait_ms`` deadline and
+  a ``max_batch`` cap, padding ragged rows exactly like the learner-local
+  batched generator — runs ONE ``batch_inference`` per tick, performs masked
+  sampling engine-side (the same audited routine the B=1 path uses, so
+  episode records stay bit-identical), and fans the
+  ``(action, prob, value, hidden')`` replies back over the Hub.
+
+* :class:`RemoteModel` / :class:`RemoteModelCache` — the worker-side proxies.
+  A worker in engine mode never touches params: its "model" is a handle that
+  turns ``act``/``inference`` calls into request frames on the existing
+  worker<->gather pipe (multiplexed by the gather's Hub event loop alongside
+  the task RPCs).
+
+* :class:`ModelVault` — the snapshot-materialization LRU (moved here from
+  ``worker.py``; the per-worker B=1 path still uses it directly). Capacity
+  is the ``inference.vault_size`` knob. Two ids of the same architecture
+  never alias one set of live params.
+
+Recurrent state rides the requests: a request with ``hidden=None`` against a
+recurrent model gets a fresh ``init_hidden()`` engine-side (episode start),
+and every reply carries the advanced per-row hidden for the worker to send
+back on its next ply — the engine itself holds no per-episode state, so
+workers may crash/join at any time without poisoning the service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import telemetry
+from .connection import INFER_KIND, send_recv
+from .generation import masked_sample_batch, pad_to_bucket
+from .model import ModelWrapper, RandomModel
+from .utils.tree import map_structure
+
+_LOG = telemetry.get_logger('inference')
+
+_UNSET = object()   # per-wrapper init_hidden cache sentinel
+
+
+def _canon(x):
+    """Rebind an unpickled ndarray's dtype to the interned descriptor.
+
+    Arrays that crossed the engine pipe carry a fresh ``dtype`` instance;
+    value-equal but not identical to numpy's interned singleton. Pickle
+    memoizes dtype objects by IDENTITY, so a moment dict mixing local and
+    wire arrays would serialize to different bytes than an all-local one —
+    breaking the bit-identical episode record contract. Rebinding is O(1)
+    (descriptor swap, no data copy)."""
+    if isinstance(x, np.ndarray):
+        x.dtype = np.dtype(x.dtype.str)
+    return x
+
+
+class ModelVault:
+    """Small LRU of materialized models keyed by model id.
+
+    ``fetch(model_id)`` pulls a snapshot over the RPC connection on miss.
+    Each cached id owns its wrapper (sharing only the per-architecture jit
+    cache inside ModelWrapper), so distinct ids never share live params.
+    Id 0 denotes the untrained epoch-0 net and is served as a RandomModel —
+    a deliberate, documented divergence (see PARITY.md): its uniform play
+    matches the sampler's selected_prob, keeping training math identical.
+    """
+
+    def __init__(self, fetch, example_obs, capacity: int = 3):
+        self._fetch = fetch
+        self._example_obs = example_obs
+        self._capacity = max(1, int(capacity))
+        self._slots: OrderedDict = OrderedDict()
+        self._templates: Dict[str, Any] = {}   # arch -> params pytree
+        self.fetches = 0                       # snapshot pulls (cache misses)
+
+    def obtain(self, wanted: Dict[Any, Optional[int]]) -> Dict[Any, Any]:
+        """Return player -> model for every requested id (None/negative ->
+        no model: the server assigns those seats to built-in opponents)."""
+        out = {}
+        for player, mid in wanted.items():
+            if mid is None or mid < 0:
+                out[player] = None
+                continue
+            out[player] = self.model(mid)
+        return out
+
+    def model(self, mid: int):
+        """The materialized model for one id (admitting it on miss)."""
+        if mid not in self._slots:
+            self._admit(mid)
+        self._slots.move_to_end(mid)
+        return self._slots[mid]
+
+    def _admit(self, mid: int):
+        snap = self._fetch(mid)
+        self.fetches += 1
+        # template key includes the wire config: the same architecture with
+        # a different param-tree-shaping knob (e.g. GeisterNet norm_kind)
+        # must not reuse a structurally different template
+        key = (snap['architecture'], tuple(sorted(snap.get('config', {}).items())))
+        wrapper = ModelWrapper.from_snapshot(
+            snap, self._example_obs,
+            params_template=self._templates.get(key))
+        self._templates.setdefault(key, wrapper.params)
+        model = RandomModel(wrapper, self._example_obs) if mid == 0 else wrapper
+        while len(self._slots) >= self._capacity:
+            self._slots.popitem(last=False)
+        self._slots[mid] = model
+
+
+class RemoteModel:
+    """Worker-side model handle: calls become engine request frames.
+
+    Presents the model surface the generators/agents dispatch on
+    (``inference`` / ``init_hidden`` plus the engine-native ``act``), but
+    holds no params — every call is one strict call-response round trip on
+    the worker's pipe, routed by the gather's Hub to the host engine.
+    ``init_hidden`` returns None by design: the engine substitutes a fresh
+    initial state for a None hidden, so the worker needs no knowledge of
+    the recurrent state's structure.
+    """
+
+    def __init__(self, conn, model_id: int):
+        self.conn = conn
+        self.model_id = int(model_id)
+        self._rid = 0
+
+    def init_hidden(self, batch_shape=None):
+        return None
+
+    def _send(self, body: Dict[str, Any]) -> int:
+        self._rid += 1
+        body['rid'] = self._rid
+        body['mid'] = self.model_id
+        self.conn.send((INFER_KIND, body))
+        return self._rid
+
+    def _recv(self, rid: int) -> Dict[str, Any]:
+        reply = self.conn.recv()
+        if not isinstance(reply, dict):
+            raise ConnectionError('inference engine reply was %r' % (reply,))
+        if reply.get('error'):
+            raise RuntimeError('inference engine: %s' % (reply['error'],))
+        if reply.get('rid') != rid:
+            raise ConnectionError('inference reply out of order (rid %r, '
+                                  'expected %d)' % (reply.get('rid'), rid))
+        return map_structure(_canon, reply)
+
+    def _rpc(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._recv(self._send(body))
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        """Full-output forward (observer plies, evaluation agents)."""
+        return self._rpc({'obs': obs, 'hidden': hidden})['outputs']
+
+    def act(self, obs, hidden, legal_actions, seed_seq) -> Dict[str, Any]:
+        """Engine-side masked sampling: one round trip returns the sampled
+        action, its probability, the action mask, value and hidden'."""
+        return self._recv(self.act_send(obs, hidden, legal_actions, seed_seq))
+
+    # split act: generators submit every simultaneous-turn request before
+    # collecting any reply, so one worker's plies coalesce into the same
+    # engine batch (replies come back FIFO on the worker's pipe — the Hub
+    # serves per-endpoint outboxes and the engine answers groups in
+    # arrival order, so send order IS receive order)
+    def act_send(self, obs, hidden, legal_actions, seed_seq) -> int:
+        return self._send({'obs': obs, 'hidden': hidden,
+                           'legal': [int(a) for a in legal_actions],
+                           'seed': [int(s) for s in seed_seq]})
+
+    act_recv = _recv
+
+
+class RemoteModelCache:
+    """Engine-mode stand-in for the worker's ModelVault: same ``obtain``
+    surface, but entries are weightless wire proxies instead of
+    materialized snapshots."""
+
+    def __init__(self, conn, capacity: int = 8):
+        self.conn = conn
+        self._capacity = max(1, int(capacity))
+        self._slots: OrderedDict = OrderedDict()
+
+    def obtain(self, wanted: Dict[Any, Optional[int]]) -> Dict[Any, Any]:
+        out = {}
+        for player, mid in wanted.items():
+            if mid is None or mid < 0:
+                out[player] = None
+                continue
+            if mid not in self._slots:
+                while len(self._slots) >= self._capacity:
+                    self._slots.popitem(last=False)
+                self._slots[mid] = RemoteModel(self.conn, mid)
+            self._slots.move_to_end(mid)
+            out[player] = self._slots[mid]
+        return out
+
+
+class InferenceEngine:
+    """Coalescing batched-inference server for one host's episode workers.
+
+    ``submit(endpoint, request)`` may be called from any thread (the
+    gather's Hub loop); a single engine thread drains the queue in ticks:
+    it waits until ``max_batch`` requests are pending, ``batch_wait_ms``
+    has passed since the oldest arrival, or the queue has gone quiescent
+    with at least ``clients`` requests waiting (see ``_collect``); then it
+    groups the tick's requests per model id, pads each group to a
+    power-of-two row bucket, runs ONE ``batch_inference`` per group, samples
+    actions engine-side for the rows that carry legal actions, and replies
+    through ``reply_fn(endpoint, message)``.
+
+    A failure while serving a group (snapshot fetch error, model crash)
+    answers the affected requests with an ``error`` reply — the worker
+    raises, loses that one episode, and the service keeps running.
+    """
+
+    def __init__(self, args: Dict[str, Any], fetch_snapshot: Callable,
+                 reply_fn: Callable, clients: Optional[int] = None,
+                 example_obs=None):
+        inf = dict(args.get('inference') or {})
+        self.batch_wait = max(0.0, float(inf.get('batch_wait_ms', 2.0))) / 1e3
+        self.max_batch = max(1, int(inf.get('max_batch', 64)))
+        self.vault_size = int(inf.get('vault_size', 3))
+        self.clients = clients
+        self._args = args
+        self._fetch = fetch_snapshot
+        self._reply = reply_fn
+        self._example_obs = example_obs
+        self.vault: Optional[ModelVault] = None   # built lazily (engine thread)
+        self._queue: deque = deque()              # (endpoint, request, t_arrival)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # local tallies mirror the registry so the fill ratio is computable
+        # even with telemetry disabled (the bench/smoke contract reads it)
+        self.requests_served = 0
+        self.batches_run = 0
+        self._m_requests = telemetry.counter('engine_requests_total')
+        self._m_batches = telemetry.counter('engine_batches_total')
+        self._m_rows = telemetry.REGISTRY.histogram(
+            'engine_batch_rows', buckets=telemetry.BATCH_ROW_BUCKETS)
+        self._m_wait = telemetry.REGISTRY.histogram('engine_coalesce_seconds')
+        self._m_depth = telemetry.gauge('engine_queue_depth')
+        self._m_fill = telemetry.gauge('engine_batch_fill_ratio')
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> 'InferenceEngine':
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def batch_fill_ratio(self) -> float:
+        """Mean requests per dispatched forward batch (1.0 = no coalescing
+        benefit over per-worker B=1)."""
+        return self.requests_served / max(1, self.batches_run)
+
+    # -- request intake (any thread) --------------------------------------
+
+    def submit(self, endpoint, request: Dict[str, Any]):
+        with self._cv:
+            self._queue.append((endpoint, request, time.monotonic()))
+            self._m_depth.set(len(self._queue))
+            self._cv.notify()
+
+    # -- engine thread ----------------------------------------------------
+
+    def _ensure_vault(self):
+        if self.vault is not None:
+            return
+        example_obs = self._example_obs
+        if example_obs is None:
+            from .environment import make_env
+            env = make_env(dict(self._args['env']))
+            env.reset()
+            example_obs = env.observation(env.players()[0])
+        self.vault = ModelVault(self._fetch, example_obs,
+                                capacity=self.vault_size)
+
+    def _collect(self) -> Optional[List[tuple]]:
+        """Block until a tick's worth of requests is due; None on stop.
+
+        A tick dispatches when ``max_batch`` requests are pending, when
+        ``batch_wait_ms`` has elapsed since the oldest arrival (the hard
+        latency cap), or when the queue has gone QUIESCENT — no new arrival
+        for a fraction of the deadline while at least ``clients`` requests
+        wait. Quiescence is the early-dispatch workhorse: submitters push
+        their whole turn burst back-to-back, so a silent queue means
+        everyone who was going to join this batch already has, and holding
+        the deadline out would only add latency, not fill."""
+        gap = max(2e-4, self.batch_wait / 8)
+        floor = min(self.max_batch, max(1, self.clients or 1))
+        with self._cv:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._cv.wait(1.0)
+            deadline = self._queue[0][2] + self.batch_wait
+            while len(self._queue) < self.max_batch and not self._stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                before = len(self._queue)
+                self._cv.wait(min(remaining, gap))
+                if len(self._queue) == before and before >= floor:
+                    break
+            n = min(len(self._queue), self.max_batch)
+            items = [self._queue.popleft() for _ in range(n)]
+            self._m_depth.set(len(self._queue))
+        self._m_wait.observe(time.monotonic() - items[0][2])
+        return items
+
+    def _loop(self):
+        while True:
+            items = self._collect()
+            if items is None:
+                return
+            groups: Dict[int, List[tuple]] = {}
+            for item in items:
+                groups.setdefault(int(item[1]['mid']), []).append(item)
+            for mid, group in groups.items():
+                try:
+                    self._serve(mid, group)
+                except Exception as exc:
+                    _LOG.warning('engine: serving model %d failed (%s: %s)',
+                                 mid, type(exc).__name__, str(exc)[:200])
+                    _LOG.debug('%s', traceback.format_exc())
+                    for ep, req, _t in group:
+                        self._reply(ep, {'rid': req.get('rid'),
+                                         'error': '%s: %s'
+                                         % (type(exc).__name__,
+                                            str(exc)[:200])})
+
+    def _serve(self, mid: int, group: List[tuple]):
+        self._ensure_vault()
+        model = self.vault.model(mid)
+        reqs = [req for _ep, req, _t in group]
+        rows = len(reqs)
+        self.requests_served += rows
+        self.batches_run += 1
+        self._m_requests.inc(rows)
+        self._m_batches.inc()
+        self._m_rows.observe(rows)
+        self._m_fill.set(self.batch_fill_ratio())
+
+        if isinstance(model, RandomModel):
+            # id 0: zero outputs, no forward pass — masked sampling over a
+            # zero policy is exactly the uniform play RandomModel encodes
+            out = model.inference(None)
+            policies = np.broadcast_to(out['policy'],
+                                       (rows,) + out['policy'].shape)
+            values = (np.broadcast_to(out['value'],
+                                      (rows,) + out['value'].shape)
+                      if 'value' in out else None)
+            next_hidden = None
+        else:
+            obs_batch, _ = pad_to_bucket([r['obs'] for r in reqs])
+            init = getattr(model, '_engine_h0', _UNSET)
+            if init is _UNSET:
+                init = model.init_hidden()
+                model._engine_h0 = init
+            hidden_batch = None
+            if init is not None:
+                hidden_batch, _ = pad_to_bucket(
+                    [r.get('hidden') if r.get('hidden') is not None else init
+                     for r in reqs])
+            outputs = model.batch_inference(obs_batch, hidden_batch)
+            policies = np.asarray(outputs['policy'])
+            values = (np.asarray(outputs['value'])
+                      if outputs.get('value') is not None else None)
+            next_hidden = outputs.get('hidden')
+
+        act_rows = [n for n, r in enumerate(reqs) if r.get('legal') is not None]
+        if act_rows:
+            actions, probs, masks = masked_sample_batch(
+                policies[act_rows],
+                [reqs[n]['legal'] for n in act_rows],
+                [reqs[n].get('seed') or [0] for n in act_rows])
+        act_index = {n: k for k, n in enumerate(act_rows)}
+
+        for n, (ep, req, _t) in enumerate(group):
+            hidden_row = None
+            if next_hidden is not None:
+                hidden_row = map_structure(
+                    lambda a: np.asarray(a)[n], next_hidden)
+            if n in act_index:
+                k = act_index[n]
+                reply = {'rid': req.get('rid'),
+                         'action': int(actions[k]), 'prob': probs[k],
+                         'action_mask': masks[k],
+                         'value': values[n] if values is not None else None,
+                         'hidden': hidden_row}
+            else:
+                row_out = {'policy': policies[n]}
+                if values is not None:
+                    row_out['value'] = values[n]
+                if hidden_row is not None:
+                    row_out['hidden'] = hidden_row
+                reply = {'rid': req.get('rid'), 'outputs': row_out}
+            self._reply(ep, reply)

@@ -48,9 +48,16 @@ MOMENT_KEYS = ('observation', 'selected_prob', 'action_mask', 'action',
                'value', 'reward', 'return')
 
 
-def compress_moments(moments: List[dict], compress_steps: int) -> List[bytes]:
-    """Chunk + compress a finished episode's moments."""
-    return [bz2.compress(pickle.dumps(moments[i:i + compress_steps]))
+def compress_moments(moments: List[dict], compress_steps: int,
+                     level: int = 9) -> List[bytes]:
+    """Chunk + compress a finished episode's moments.
+
+    ``level`` is bz2's compresslevel (1 fastest .. 9 smallest, the bz2
+    default): on engine-mode workers compression dominates the remaining
+    per-episode CPU, so hosts squeezed for actor cycles can trade upload
+    bytes for throughput via the ``compress_level`` config knob."""
+    return [bz2.compress(pickle.dumps(moments[i:i + compress_steps]),
+                         compresslevel=int(level))
             for i in range(0, len(moments), compress_steps)]
 
 

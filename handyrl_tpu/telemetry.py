@@ -175,6 +175,10 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 INGEST_STAGES: Tuple[str, ...] = (
     'select', 'decode', 'assemble', 'ipc', 'h2d', 'compute', 'drain')
 
+# Row-count buckets for batching histograms (e.g. the inference engine's
+# engine_batch_rows): powers of two matching the padded dispatch buckets.
+BATCH_ROW_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
 
 class Counter:
     """Monotonic labeled counter."""

@@ -2024,6 +2024,13 @@ class Learner:
                                 role_args['player'] = self.env.players()
                                 for p in self.env.players():
                                     role_args['model_id'][p] = self.model_epoch
+                                # the action-sampling key: with it, the
+                                # episode is a pure function of (seed,
+                                # sample_key, params) — identical on the
+                                # per-worker and engine inference paths,
+                                # on whichever worker the task (or its
+                                # ledger re-issue) lands
+                                role_args['sample_key'] = self.num_episodes
                                 self.num_episodes += 1
                             else:
                                 players = self.env.players()
@@ -2033,6 +2040,7 @@ class Learner:
                                     role_args['model_id'][p] = (
                                         self.model_epoch if p in role_args['player']
                                         else -1)
+                                role_args['sample_key'] = self.num_results
                                 self.num_results += 1
                         ledger.assign(conn, role_args)
                         send_data.append(role_args)

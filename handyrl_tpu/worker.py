@@ -12,6 +12,12 @@ data connections on port 9998 all match the reference topology
 * workers cache model *snapshots per model id* in a small LRU vault and
   materialize wrappers per id — two ids of the same architecture can never
   alias one set of live params (a league/past-epoch opponent setup works);
+* with the ``inference`` config block enabled, workers become pure
+  env-steppers: the host relay (Gather) spawns one
+  :class:`~.inference.InferenceEngine` that alone materializes snapshots
+  and serves coalesced batched forward passes for every worker on the
+  host — the 'model' RPC then flows learner -> gather -> engine only, so
+  model broadcast cost is O(hosts), not O(workers);
 * the 'model' RPC ships an architecture-name + msgpack-params snapshot
   (model.ModelWrapper.snapshot), never pickled code, and socket frames are
   msgpack data — nothing on the public ports can execute on decode.
@@ -31,14 +37,17 @@ from socket import gethostname
 from typing import Any, Dict, Optional
 
 from . import telemetry
-from .connection import (HEARTBEAT_KIND, Hub, accept_socket_connections,
+from .connection import (HEARTBEAT_KIND, INFER_KIND, Hub,
+                         accept_socket_connections,
                          connect_socket_connection, force_cpu_backend,
                          send_recv, spawn_pipe_workers)
 from .environment import make_env, prepare_env
 from .evaluation import Evaluator
 from .fault import Backoff, parse_chaos
 from .generation import Generator
-from .model import ModelWrapper, RandomModel
+# ModelVault moved to inference.py (the engine shares it); re-exported here
+# for compatibility with existing imports
+from .inference import InferenceEngine, ModelVault, RemoteModelCache
 
 _LOG = telemetry.get_logger('worker')
 
@@ -50,54 +59,6 @@ DATA_PORT = int(os.environ.get('HANDYRL_TPU_DATA_PORT', 9998))
 # connection-death signatures on the blocking RPC paths (sockets AND pipes);
 # socket.timeout / Broken/ResetError are OSError subclasses
 _CONN_ERRORS = (OSError, EOFError, ConnectionError)
-
-
-class ModelVault:
-    """Small LRU of materialized models keyed by model id.
-
-    ``fetch(model_id)`` pulls a snapshot over the RPC connection on miss.
-    Each cached id owns its wrapper (sharing only the per-architecture jit
-    cache inside ModelWrapper), so distinct ids never share live params.
-    Id 0 denotes the untrained epoch-0 net and is served as a RandomModel —
-    a deliberate, documented divergence (see PARITY.md): its uniform play
-    matches the sampler's selected_prob, keeping training math identical.
-    """
-
-    def __init__(self, fetch, example_obs, capacity: int = 3):
-        self._fetch = fetch
-        self._example_obs = example_obs
-        self._capacity = capacity
-        self._slots: OrderedDict = OrderedDict()
-        self._templates: Dict[str, Any] = {}   # arch -> params pytree
-
-    def obtain(self, wanted: Dict[Any, Optional[int]]) -> Dict[Any, Any]:
-        """Return player -> model for every requested id (None/negative ->
-        no model: the server assigns those seats to built-in opponents)."""
-        out = {}
-        for player, mid in wanted.items():
-            if mid is None or mid < 0:
-                out[player] = None
-                continue
-            if mid not in self._slots:
-                self._admit(mid)
-            self._slots.move_to_end(mid)
-            out[player] = self._slots[mid]
-        return out
-
-    def _admit(self, mid: int):
-        snap = self._fetch(mid)
-        # template key includes the wire config: the same architecture with
-        # a different param-tree-shaping knob (e.g. GeisterNet norm_kind)
-        # must not reuse a structurally different template
-        key = (snap['architecture'], tuple(sorted(snap.get('config', {}).items())))
-        wrapper = ModelWrapper.from_snapshot(
-            snap, self._example_obs,
-            params_template=self._templates.get(key))
-        self._templates.setdefault(key, wrapper.params)
-        model = RandomModel(wrapper, self._example_obs) if mid == 0 else wrapper
-        while len(self._slots) >= self._capacity:
-            self._slots.popitem(last=False)
-        self._slots[mid] = model
 
 
 class Worker:
@@ -117,12 +78,19 @@ class Worker:
         self._hb_interval = float(ft.get('heartbeat_interval', 10.0))
         self._hb_next = time.time() + self._hb_interval
 
-        self.env.reset()
-        example_obs = self.env.observation(self.env.players()[0])
-        self.vault = ModelVault(
-            lambda mid: send_recv(conn, ('model', mid)), example_obs)
+        inf = args.get('inference') or {}
+        if inf.get('enabled'):
+            # engine mode: this process never materializes params — models
+            # are wire proxies onto the host relay's InferenceEngine
+            self.vault = RemoteModelCache(conn)
+        else:
+            self.env.reset()
+            example_obs = self.env.observation(self.env.players()[0])
+            self.vault = ModelVault(
+                lambda mid: send_recv(conn, ('model', mid)), example_obs,
+                capacity=int(inf.get('vault_size', 3)))
 
-        generator = Generator(self.env, args)
+        generator = Generator(self.env, args, namespace=wid)
         evaluator = Evaluator(self.env, args)
         # role -> (episode producer, upload RPC name)
         self.playbook = {'g': (generator.execute, 'episode'),
@@ -277,6 +245,18 @@ class Gather:
         self._snap_cache: OrderedDict = OrderedDict()
         self._upload_box: Dict[str, list] = defaultdict(list)
         self._upload_count = 0
+        # the engine thread fetches snapshots through the same server link
+        # as the main task loop: RPCs must not interleave on the wire
+        self._rpc_lock = threading.RLock()
+
+        self.engine: Optional[InferenceEngine] = None
+        if (args.get('inference') or {}).get('enabled'):
+            # per-host batched inference service: this relay alone pulls
+            # model snapshots; its workers submit (mid, obs, hidden, legal)
+            # frames and receive sampled actions back over the same pipes
+            self.engine = InferenceEngine(
+                args, fetch_snapshot=self._snapshot,
+                reply_fn=self.hub.send, clients=n_here).start()
 
     def __del__(self):
         _LOG.info('finished gather %d', self.gather_id)
@@ -351,15 +331,19 @@ class Gather:
     def _server_rpc(self, msg):
         """send_recv with supervised reconnect; the in-flight request is
         resent on the fresh link (the server dedupes by task_id, so a
-        request whose ack was lost cannot double-count)."""
-        while True:
-            try:
-                return send_recv(self.server, msg)
-            except _CONN_ERRORS as exc:
-                if self._reconnect_fn is None:   # pipe mode: not recoverable
-                    raise
-                self._m_retries.inc()
-                self._recover(exc)
+        request whose ack was lost cannot double-count). Serialized: the
+        engine thread's snapshot fetches share this link with the main
+        task loop, and two interleaved call-response pairs would cross
+        their replies."""
+        with self._rpc_lock:
+            while True:
+                try:
+                    return send_recv(self.server, msg)
+                except _CONN_ERRORS as exc:
+                    if self._reconnect_fn is None:  # pipe mode: unrecoverable
+                        raise
+                    self._m_retries.inc()
+                    self._recover(exc)
 
     # -- per-RPC handling --
 
@@ -372,13 +356,16 @@ class Gather:
     def _snapshot(self, mid):
         """Per-id snapshot LRU: one epoch's params per entry, bounded — the
         epoch counter increments for the life of the run, so an unbounded
-        map would leak a params-sized blob per update."""
-        if mid not in self._snap_cache:
-            while len(self._snap_cache) >= self.SNAP_SLOTS:
-                self._snap_cache.popitem(last=False)
-            self._snap_cache[mid] = self._server_rpc(('model', mid))
-        self._snap_cache.move_to_end(mid)
-        return self._snap_cache[mid]
+        map would leak a params-sized blob per update. Thread-safe: serves
+        both worker 'model' RPCs (per-worker mode) and the inference
+        engine's fetches (engine mode)."""
+        with self._rpc_lock:
+            if mid not in self._snap_cache:
+                while len(self._snap_cache) >= self.SNAP_SLOTS:
+                    self._snap_cache.popitem(last=False)
+                self._snap_cache[mid] = self._server_rpc(('model', mid))
+            self._snap_cache.move_to_end(mid)
+            return self._snap_cache[mid]
 
     def _stash_upload(self, kind: str, payload):
         self._upload_box[kind].append(payload)
@@ -411,13 +398,44 @@ class Gather:
                 self.hub.send(ep, self._next_task())
             elif kind == 'model':
                 self.hub.send(ep, self._snapshot(body))
+            elif kind == INFER_KIND:
+                if self.engine is None:
+                    self.hub.send(ep, {'rid': (body or {}).get('rid'),
+                                       'error': 'inference engine disabled '
+                                                'on this host'})
+                else:
+                    self.engine.submit(ep, body)
             else:
                 self.hub.send(ep, None)       # ack now, ship in bulk later
                 self._stash_upload(kind, body)
+        # all workers retired (training over): ship the final partial
+        # upload block — it would otherwise die in the box — and beacon a
+        # last telemetry snapshot so the learner's fleet view includes
+        # this relay's complete engine/upload counters
+        for kind in list(self._upload_box):
+            if self._upload_box[kind]:
+                self._server_rpc((kind, self._upload_box[kind]))
+            del self._upload_box[kind]
+        if self.engine is not None:
+            self.engine.stop()
+        try:
+            self.server.send((HEARTBEAT_KIND,
+                              {'gather': self.gather_id, **self.stats,
+                               'telemetry': telemetry.snapshot()}))
+        except Exception:
+            pass   # the run is over; a dead link changes nothing
 
 
 def gather_loop(args, conn, gather_id, server_address=None):
-    force_cpu_backend()
+    inf = args.get('inference') or {}
+    if inf.get('enabled') and str(inf.get('engine_backend', 'cpu')) == 'device':
+        # the engine is the ONE process on this host allowed to claim a
+        # local accelerator (hosts without one fall back to jax's default);
+        # workers stay CPU-pinned either way
+        from . import setup_compile_cache
+        setup_compile_cache()
+    else:
+        force_cpu_backend()
     reconnect = None
     if server_address:
         def reconnect():

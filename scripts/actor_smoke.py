@@ -1,0 +1,62 @@
+"""CI smoke for the actor inference service (docs/large_scale_training.md).
+
+Runs ``bench.py`` in BENCH_MODE=actor on a tiny CPU geometry (TicTacToe,
+2 workers) — a real gather + worker-process fleet over the 4-RPC protocol,
+once with the per-host InferenceEngine and once on the per-worker B=1
+path — and asserts the service contract rather than a throughput number
+(CI machines are too noisy for thresholds):
+
+  * the run completes and honors the one-JSON-line stdout contract;
+  * the engine actually coalesces: batch-fill ratio > 1 worker-equivalent;
+  * episode records are byte-compatible with the per-worker path under the
+    fixed seed (the bit-identical record contract).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.update({
+        'JAX_PLATFORMS': 'cpu',
+        'BENCH_MODE': 'actor',
+        'BENCH_ACTOR_ENV': 'TicTacToe',
+        'BENCH_ACTOR_WORKERS': '2',
+        'BENCH_ACTOR_EPISODES': '12',
+        'BENCH_ACTOR_WARMUP': '2',
+        # generous coalescing window: the smoke asserts batching works, not
+        # that it is fast, and CI boxes schedule workers erratically
+        'BENCH_ACTOR_WAIT_MS': '20',
+        'BENCH_DEADLINE_SEC': env.get('BENCH_DEADLINE_SEC', '540'),
+    })
+    proc = subprocess.run([sys.executable, os.path.join(REPO, 'bench.py')],
+                          env=env, stdout=subprocess.PIPE, text=True,
+                          timeout=600)
+    out = proc.stdout.strip().splitlines()
+    assert len(out) == 1, 'one-JSON-line contract violated: %r' % (out,)
+    row = json.loads(out[0])
+    print(json.dumps(row, indent=2))
+    assert 'error' not in row, row.get('error')
+    assert row['value'] > 0, 'engine fleet produced no measured episodes'
+    assert row['per_worker_episodes_per_sec'] > 0, \
+        'per-worker fleet produced no measured episodes'
+    assert row['failed_episodes'] == 0, row
+    assert row['batch_fill'] > 1.0, \
+        'engine never coalesced past 1 request/batch (fill %.2f)' \
+        % row['batch_fill']
+    assert row['records_identical'] is True, \
+        'engine-path episode records are not byte-compatible with the ' \
+        'per-worker path'
+    print('actor smoke OK: fill %.2f, %.1f eps/s engine vs %.1f per-worker'
+          % (row['batch_fill'], row['value'],
+             row['per_worker_episodes_per_sec']))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -32,6 +32,26 @@ def turn_based_episode(steps=5, obs_shape=(3, 3, 3), n_actions=9, seed=None):
     }
 
 
+def ragged_act_rows(n, n_actions=9, obs_shape=(3, 3, 3), hidden_dim=None,
+                    seed=0):
+    """Shared ragged-row fixture: ``n`` act requests with mixed legal-action
+    counts (1..n_actions legal moves per row), random observations, and —
+    when ``hidden_dim`` is set — a per-row recurrent state vector. Used by
+    the padding/bucketing tests and the inference-engine tests, so both
+    exercise the same raggedness."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n):
+        count = int(rng.randint(1, n_actions + 1))
+        legal = sorted(rng.choice(n_actions, size=count,
+                                  replace=False).tolist())
+        obs = rng.rand(*obs_shape).astype(np.float32)
+        hidden = (rng.rand(hidden_dim).astype(np.float32)
+                  if hidden_dim else None)
+        rows.append({'obs': obs, 'legal': legal, 'hidden': hidden})
+    return rows
+
+
 def train_args(forward_steps=4, burn_in=0, observation=False, turn_based=True):
     return {
         'turn_based_training': turn_based, 'observation': observation,

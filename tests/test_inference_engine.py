@@ -1,0 +1,441 @@
+"""Inference-service tests: the shared masked sampler, ragged-row padding,
+the snapshot vault, and the coalescing InferenceEngine — including
+byte-identity of episode records between the per-worker B=1 path and the
+engine path (the bench/CI contract), with a simulated pipe hop so dtype
+canonicalization is exercised too."""
+
+import pickle
+import queue
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from handyrl_tpu import models as model_zoo
+from handyrl_tpu.connection import INFER_KIND, pack
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.generation import (Generator, masked_sample,
+                                    masked_sample_batch, model_act,
+                                    pad_to_bucket, sample_seed)
+from handyrl_tpu.inference import (InferenceEngine, ModelVault, RemoteModel,
+                                   RemoteModelCache)
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.utils.tree import softmax
+
+from helpers import ragged_act_rows
+
+GEN_ARGS = {'observation': False, 'gamma': 0.8, 'compress_steps': 4,
+            'seed': 11}
+
+
+@model_zoo.register('TinyRecurrent')
+class TinyRecurrent(nn.Module):
+    """Minimal recurrent module for hidden round-trip coverage."""
+    feats: int = 8
+    n_actions: int = 9
+
+    @nn.compact
+    def __call__(self, x, hidden=None):
+        if hidden is None:
+            hidden = self.init_hidden(x.shape[:1])
+        flat = x.reshape(x.shape[0], -1)
+        h = jnp.tanh(nn.Dense(self.feats)(flat) + hidden)
+        return {'policy': nn.Dense(self.n_actions)(h),
+                'value': jnp.tanh(nn.Dense(1)(h)),
+                'hidden': h}
+
+    def init_hidden(self, batch_shape=()):
+        return jnp.zeros(tuple(batch_shape) + (self.feats,))
+
+
+def _ttt_wrapper(seed=7):
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    w = ModelWrapper(env.net(), seed=seed)
+    w.ensure_params(env.observation(0))
+    return env, w
+
+
+# ---------------------------------------------------------------------------
+# masked sampling — the one audited routine
+
+
+def test_masked_sample_deterministic_and_legal():
+    rng = np.random.RandomState(3)
+    for row in ragged_act_rows(16, seed=5):
+        policy = rng.randn(9).astype(np.float32)
+        seed_seq = sample_seed(11, (0, 4), 2)
+        a1, p1, m1 = masked_sample(policy, row['legal'], seed_seq)
+        a2, p2, m2 = masked_sample(policy, row['legal'], seed_seq)
+        assert a1 == a2 and p1 == p2          # pure function of the seed
+        assert a1 in row['legal']
+        # mask: +1e32 on illegal, 0 on legal (the reference contract)
+        assert np.all(m1[row['legal']] == 0)
+        illegal = [a for a in range(9) if a not in row['legal']]
+        assert np.all(m1[illegal] == np.float32(1e32))
+        # recorded prob is the masked softmax prob of the taken action
+        ref = softmax(policy - m1)
+        assert p1 == ref[a1]
+        np.testing.assert_array_equal(m1, m2)
+
+
+def test_masked_sample_batch_matches_single():
+    rng = np.random.RandomState(0)
+    rows = ragged_act_rows(12, seed=1)
+    policies = rng.randn(12, 9).astype(np.float32)
+    seeds = [sample_seed(11, (0, k), k) for k in range(12)]
+    actions, probs, masks = masked_sample_batch(
+        policies, [r['legal'] for r in rows], seeds)
+    for k, row in enumerate(rows):
+        a, p, m = masked_sample(policies[k], row['legal'], seeds[k])
+        assert actions[k] == a
+        assert probs[k] == p                  # bit-identical
+        np.testing.assert_array_equal(masks[k], m)
+
+
+def test_masked_sample_draw_index_varies():
+    policy = np.zeros(9, np.float32)          # uniform over legal
+    legal = list(range(9))
+    draws = {masked_sample(policy, legal,
+                           sample_seed(11, (0, 1), i))[0]
+             for i in range(32)}
+    assert len(draws) > 3                     # different indices, new draws
+
+
+# ---------------------------------------------------------------------------
+# ragged-row padding
+
+
+def test_pad_to_bucket_shapes_and_content():
+    rows = ragged_act_rows(5, seed=2)
+    batch, n = pad_to_bucket([r['obs'] for r in rows])
+    assert n == 5 and batch.shape == (8, 3, 3, 3)   # min bucket 8
+    for k, row in enumerate(rows):
+        np.testing.assert_array_equal(batch[k], row['obs'])
+    np.testing.assert_array_equal(batch[5], rows[0]['obs'])  # pad = row 0
+
+    batch, n = pad_to_bucket([r['obs'] for r in ragged_act_rows(9, seed=3)])
+    assert n == 9 and batch.shape[0] == 16          # next power of two
+
+    batch, n = pad_to_bucket([rows[0]['obs']])
+    assert n == 1 and batch.shape[0] == 8           # B=1 pads to min bucket
+
+
+def test_pad_to_bucket_pytree():
+    rows = [{'a': np.ones((2,), np.float32) * i,
+             'b': (np.zeros((3,), np.float32) + i,)} for i in range(3)]
+    batch, n = pad_to_bucket(rows)
+    assert n == 3
+    assert batch['a'].shape == (8, 2) and batch['b'][0].shape == (8, 3)
+    np.testing.assert_array_equal(batch['a'][2], np.ones(2) * 2)
+    np.testing.assert_array_equal(batch['a'][5], np.zeros(2))  # row-0 pad
+
+
+# ---------------------------------------------------------------------------
+# model vault
+
+
+def _snapshots_for(mids, seed0=1):
+    """mid -> distinct-params snapshot of the same architecture."""
+    out = {}
+    for mid in mids:
+        _env, w = _ttt_wrapper(seed=seed0 + mid)
+        out[mid] = w.snapshot()
+    return out
+
+
+def test_vault_distinct_ids_never_alias_params():
+    import jax
+    env, _ = _ttt_wrapper()
+    snaps = _snapshots_for([1, 2])
+    vault = ModelVault(lambda mid: snaps[mid], env.observation(0),
+                       capacity=3)
+    models = vault.obtain({0: 1, 1: 2})
+    leaves1 = jax.tree_util.tree_leaves(models[0].params)
+    leaves2 = jax.tree_util.tree_leaves(models[1].params)
+    assert len(leaves1) == len(leaves2) > 0
+    diff = False
+    for a, b in zip(leaves1, leaves2):
+        assert not np.shares_memory(np.asarray(a), np.asarray(b))
+        diff = diff or not np.array_equal(np.asarray(a), np.asarray(b))
+    assert diff, 'seed-1 and seed-2 snapshots should have different params'
+
+
+def test_vault_eviction_rematerializes():
+    env, _ = _ttt_wrapper()
+    snaps = _snapshots_for([1, 2, 3])
+    vault = ModelVault(lambda mid: snaps[mid], env.observation(0),
+                       capacity=2)
+    vault.obtain({0: 1})
+    vault.obtain({0: 2})
+    assert vault.fetches == 2
+    vault.obtain({0: 3})                      # evicts 1 (LRU)
+    assert vault.fetches == 3
+    assert 1 not in vault._slots and {2, 3} <= set(vault._slots)
+    m1 = vault.obtain({0: 1})[0]              # re-materialized, not stale
+    assert vault.fetches == 4
+    from flax import serialization
+    assert serialization.to_bytes(m1.params) == snaps[1]['params']
+
+
+def test_vault_negative_and_none_ids():
+    env, _ = _ttt_wrapper()
+    vault = ModelVault(lambda mid: (_ for _ in ()).throw(AssertionError),
+                       env.observation(0))
+    out = vault.obtain({0: None, 1: -1})
+    assert out == {0: None, 1: None}
+    assert vault.fetches == 0
+
+
+def test_remote_model_cache_semantics():
+    class _Conn:
+        pass
+    cache = RemoteModelCache(_Conn(), capacity=2)
+    out = cache.obtain({0: None, 1: -1, 2: 5})
+    assert out[0] is None and out[1] is None
+    assert isinstance(out[2], RemoteModel) and out[2].model_id == 5
+    again = cache.obtain({0: 5})
+    assert again[0] is out[2]                 # cached proxy identity
+    cache.obtain({0: 6})
+    cache.obtain({0: 7})                      # evicts 5 under capacity 2
+    assert cache.obtain({0: 5})[0] is not out[2]
+
+
+# ---------------------------------------------------------------------------
+# the engine itself
+
+
+class _Loopback:
+    """In-process stand-in for the worker<->gather pipe: requests go
+    straight into a live engine; replies round-trip through pickle, which
+    simulates the mp transport (fresh dtype instances and all)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.replies: queue.Queue = queue.Queue()
+
+    def send(self, msg):
+        kind, body = msg
+        assert kind == INFER_KIND
+        self.engine.submit(self, pickle.loads(pickle.dumps(body)))
+
+    def recv(self):
+        return pickle.loads(pickle.dumps(self.replies.get(timeout=30)))
+
+
+def _engine_for(snapshot_by_mid, example_obs, clients=1, batch_wait_ms=2.0,
+                max_batch=64):
+    args = {'inference': {'enabled': True, 'batch_wait_ms': batch_wait_ms,
+                          'max_batch': max_batch},
+            'env': {'env': 'TicTacToe'}}
+    engine = InferenceEngine(
+        args, fetch_snapshot=lambda mid: snapshot_by_mid[mid],
+        reply_fn=lambda ep, msg: ep.replies.put(msg),
+        clients=clients, example_obs=example_obs)
+    return engine.start()
+
+
+@pytest.mark.timeout(120)
+def test_engine_coalesces_across_clients():
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    engine = _engine_for({1: w.snapshot()}, obs, clients=4,
+                         batch_wait_ms=500.0)
+    try:
+        conns = [_Loopback(engine) for _ in range(4)]
+        models = [RemoteModel(c, 1) for c in conns]
+        rids = [m.act_send(obs, None, [0, 1, 2],
+                           sample_seed(11, (0, k), 0))
+                for k, m in enumerate(models)]
+        replies = [m.act_recv(r) for m, r in zip(models, rids)]
+        # 4 clients, quiescent queue: ONE forward served all four
+        assert engine.batches_run == 1
+        assert engine.requests_served == 4
+        assert engine.batch_fill_ratio() == 4.0
+        for rep in replies:
+            assert rep['action'] in (0, 1, 2)
+            assert isinstance(rep['prob'], np.float32)
+            assert rep['action_mask'].shape == (9,)
+    finally:
+        engine.stop()
+
+
+@pytest.mark.timeout(120)
+def test_engine_act_matches_local_path_bitwise():
+    """The engine's act reply must equal the local bucketed path exactly —
+    same action, same float bits for prob/value/mask."""
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    engine = _engine_for({1: w.snapshot()}, obs)
+    try:
+        remote = RemoteModel(_Loopback(engine), 1)
+        legal = env.legal_actions(0)
+        for draw in range(5):
+            seed_seq = sample_seed(11, (0, 9), draw)
+            res_local = model_act(w, obs, None, legal, seed_seq)
+            res_engine = model_act(remote, obs, None, legal, seed_seq)
+            assert res_local['action'] == res_engine['action']
+            assert res_local['prob'] == res_engine['prob']
+            np.testing.assert_array_equal(res_local['action_mask'],
+                                          res_engine['action_mask'])
+            np.testing.assert_array_equal(res_local['value'],
+                                          res_engine['value'])
+    finally:
+        engine.stop()
+
+
+@pytest.mark.timeout(120)
+def test_engine_recurrent_hidden_round_trip():
+    """Recurrent state rides requests/replies: a None hidden gets a fresh
+    init engine-side, and the advanced state a worker sends back produces
+    the same trajectory the local path computes."""
+    wrapper = ModelWrapper(model_zoo.build('TinyRecurrent'), seed=3)
+    rows = ragged_act_rows(1, obs_shape=(3, 3, 3), seed=4)
+    obs = rows[0]['obs']
+    wrapper.ensure_params(obs)
+    engine = _engine_for({1: wrapper.snapshot()}, obs)
+    try:
+        remote = RemoteModel(_Loopback(engine), 1)
+        h_local = wrapper.init_hidden()       # real initial state
+        h_remote = remote.init_hidden()       # None by design
+        assert h_remote is None
+        for step, row in enumerate(ragged_act_rows(6, seed=9)):
+            seed_seq = sample_seed(11, (0, 2), step)
+            res_l = model_act(wrapper, row['obs'], h_local,
+                              row['legal'], seed_seq)
+            res_r = model_act(remote, row['obs'], h_remote,
+                              row['legal'], seed_seq)
+            assert res_l['action'] == res_r['action']
+            np.testing.assert_array_equal(np.asarray(res_l['hidden']),
+                                          np.asarray(res_r['hidden']))
+            h_local, h_remote = res_l['hidden'], res_r['hidden']
+        assert h_remote is not None and np.any(np.asarray(h_remote) != 0)
+    finally:
+        engine.stop()
+
+
+@pytest.mark.timeout(120)
+def test_engine_error_reply_does_not_kill_service():
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+
+    def fetch(mid):
+        if mid == 99:
+            raise ConnectionError('no such snapshot')
+        return w.snapshot()
+
+    args = {'inference': {'enabled': True, 'batch_wait_ms': 1.0},
+            'env': {'env': 'TicTacToe'}}
+    engine = InferenceEngine(args, fetch_snapshot=fetch,
+                             reply_fn=lambda ep, msg: ep.replies.put(msg),
+                             clients=1, example_obs=obs).start()
+    try:
+        bad = RemoteModel(_Loopback(engine), 99)
+        with pytest.raises(RuntimeError, match='no such snapshot'):
+            bad.act(obs, None, [0], sample_seed(0, (0, 0), 0))
+        good = RemoteModel(_Loopback(engine), 1)   # service still alive
+        rep = good.act(obs, None, [0, 1], sample_seed(0, (0, 1), 0))
+        assert rep['action'] in (0, 1)
+    finally:
+        engine.stop()
+
+
+@pytest.mark.timeout(120)
+def test_engine_random_model_id_zero_uniform():
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    engine = _engine_for({0: w.snapshot()}, obs)
+    try:
+        remote = RemoteModel(_Loopback(engine), 0)
+        legal = [2, 5, 7]
+        rep = remote.act(obs, None, legal, sample_seed(1, (0, 0), 0))
+        assert rep['action'] in legal
+        # zero policy => uniform over legal, like worker-side RandomModel
+        assert rep['prob'] == np.float32(1.0) / np.float32(3.0) \
+            or abs(float(rep['prob']) - 1 / 3) < 1e-6
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# episode records: engine path vs per-worker path, byte for byte
+
+
+@pytest.mark.timeout(300)
+def test_episode_records_bit_identical_across_paths():
+    env, w = _ttt_wrapper()
+    snap = w.snapshot()
+    task = {'role': 'g', 'player': [0, 1], 'model_id': {0: 1, 1: 1},
+            'sample_key': 5}
+
+    local_env = make_env({'env': 'TicTacToe'})
+    local = Generator(local_env, GEN_ARGS, namespace=0)
+    w2 = ModelWrapper.from_snapshot(snap, env.observation(0))
+    episodes_local = [local.generate({0: w2, 1: w2},
+                                     dict(task, sample_key=k))
+                      for k in range(4)]
+
+    engine = _engine_for({1: snap}, env.observation(0))
+    try:
+        remote = RemoteModel(_Loopback(engine), 1)
+        eng_env = make_env({'env': 'TicTacToe'})
+        eng = Generator(eng_env, GEN_ARGS, namespace=3)  # namespace ignored
+        episodes_engine = [eng.generate({0: remote, 1: remote},
+                                        dict(task, sample_key=k))
+                           for k in range(4)]
+    finally:
+        engine.stop()
+
+    for a, b in zip(episodes_local, episodes_engine):
+        assert a is not None and b is not None
+        assert pack(a) == pack(b)             # byte-for-byte identical
+
+
+@pytest.mark.timeout(300)
+def test_episode_records_reproducible_across_workers():
+    """Same sample_key => same episode, no matter which 'worker' (namespace,
+    local draw history) runs the task — the ledger re-issue guarantee."""
+    env, w = _ttt_wrapper()
+    snap = w.snapshot()
+
+    def run(namespace, warmup_episodes):
+        e = make_env({'env': 'TicTacToe'})
+        g = Generator(e, GEN_ARGS, namespace=namespace)
+        model = ModelWrapper.from_snapshot(snap, env.observation(0))
+        for _ in range(warmup_episodes):      # advance local fallback stream
+            g.generate({0: model, 1: model}, {'role': 'g', 'player': [0, 1],
+                                              'model_id': {0: 1, 1: 1}})
+        return g.generate({0: model, 1: model},
+                          {'role': 'g', 'player': [0, 1],
+                           'model_id': {0: 1, 1: 1}, 'sample_key': 17})
+
+    assert pack(run(0, 0)) == pack(run(4, 3))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one training epoch over the real process tree, engine enabled
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_local_worker_cluster_with_engine_one_epoch(tmp_path):
+    from handyrl_tpu.config import apply_defaults
+    from handyrl_tpu.train import Learner
+    raw = {
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {
+            'batch_size': 8, 'update_episodes': 20, 'minimum_episodes': 20,
+            'epochs': 1, 'forward_steps': 8, 'num_batchers': 1,
+            'batched_generation': False,
+            'inference': {'enabled': True},
+            'worker': {'num_parallel': 2},
+            'model_dir': str(tmp_path / 'models'),
+        },
+    }
+    args = apply_defaults(raw)
+    learner = Learner(args=args)
+    learner.run()
+    assert learner.model_epoch == 1
+    assert learner.num_returned_episodes >= 20
+    assert (tmp_path / 'models' / '1.ckpt').exists()

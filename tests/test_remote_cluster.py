@@ -23,6 +23,7 @@ def main():
            'train_args': {'batch_size': 8, 'update_episodes': 15,
                           'minimum_episodes': 15, 'epochs': 1,
                           'forward_steps': 8, 'num_batchers': 1,
+                          'inference': {'enabled': %(engine)r},
                           'model_dir': %(model_dir)r}}
     args = apply_defaults(raw)
     learner = Learner(args=args, remote=True)
@@ -47,12 +48,16 @@ if __name__ == '__main__':
 '''
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
-def test_remote_train_server_and_worker(tmp_path):
+@pytest.mark.parametrize('engine', [False, True],
+                         ids=['per-worker', 'inference-engine'])
+def test_remote_train_server_and_worker(tmp_path, engine):
     model_dir = str(tmp_path / 'models')
     learner_py = tmp_path / 'learner.py'
     worker_py = tmp_path / 'worker.py'
-    learner_py.write_text(LEARNER_SCRIPT % {'model_dir': model_dir})
+    learner_py.write_text(LEARNER_SCRIPT % {'model_dir': model_dir,
+                                            'engine': engine})
     worker_py.write_text(WORKER_SCRIPT)
 
     env = {**os.environ, 'JAX_PLATFORMS': 'cpu'}
