@@ -67,6 +67,15 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'reconnect_max_delay': 30.0,   # backoff ceiling (s)
         'reconnect_max_tries': 30,     # redials before a gather gives up (and respawns before a gather slot is abandoned)
         'resend_buffer': 256,          # max unacked uploads a gather retains across reconnects; older ones are dropped + counted
+
+        # elastic fleet control (fault.FleetController, train.py server()):
+        # per-host health states derived from ledger strandings + heartbeat
+        # fault telemetry; flapping hosts are drained then quarantined
+        # (fresh tasks withheld) and re-admitted after the quarantine
+        'host_degrade_after': 1,       # fault signals (strandings or engine failovers/restarts) within host_health_window before a host is marked degraded
+        'host_quarantine_after': 3,    # strandings within the window before the host is drained (no fresh tasks) and then quarantined
+        'host_health_window': 120.0,   # sliding window (s) for per-host fault accounting
+        'host_quarantine_period': 60.0,  # quarantine length (s) before a flapping host is re-admitted with a cleared fault history
     },
 
     # learner-side crash/corruption resilience (guard.py,
@@ -89,7 +98,18 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'batch_wait_ms': 2.0,    # coalescing deadline: how long the engine holds the oldest request while the batch fills (it dispatches early once every local worker has a request in flight)
         'max_batch': 64,         # request cap per dispatched forward batch
         'engine_backend': 'cpu',  # 'cpu' pins the engine to host cores; 'device' lets the engine claim a worker-host-local accelerator (never set on hosts sharing the learner's chip)
-        'vault_size': 3,         # materialized model snapshots cached (engine-side in engine mode, per worker otherwise)
+        'vault_size': 3,         # materialized model snapshots cached (engine-side in engine mode, per worker otherwise — including a degraded worker's local fallback vault)
+
+        # self-healing tier (inference.EngineSupervisor / EngineClient,
+        # docs/large_scale_training.md "Engine failover and elastic fleet")
+        'queue_max': 1024,       # bounded engine intake queue: submits past it are shed with an immediate error reply (backpressure instead of unbounded growth); 0 = unbounded
+        'stall_timeout': 30.0,   # watchdog: a busy engine with no tick progress for this long is declared stalled, its requests error-answered, and a fresh engine started
+        'restart_max_delay': 10.0,  # supervised engine-restart backoff ceiling (s); first restart after 0.5s, doubling
+        'request_timeout': 10.0,  # worker-side deadline (s) on one engine round trip
+        'request_retries': 1,    # resends after a timeout before the worker gives up on the engine for that request
+        'failover': True,        # degrade to the per-worker inference path when the engine is unreachable (lossless: records stay byte-identical); False = raise, losing that episode
+        'reprobe_initial_delay': 2.0,  # circuit breaker: first half-open probe delay (s) after a degradation, doubling up to reprobe_max_delay
+        'reprobe_max_delay': 30.0,     # probe backoff ceiling (s)
     },
 
     # unified telemetry (docs/observability.md): metric registry + spans +
@@ -160,7 +180,9 @@ def validate(args: Dict[str, Any]) -> None:
     for key in ('heartbeat_interval', 'liveness_timeout', 'rpc_timeout',
                 'task_deadline', 'reconnect_initial_delay',
                 'reconnect_max_delay', 'reconnect_max_tries',
-                'resend_buffer'):
+                'resend_buffer', 'host_degrade_after',
+                'host_quarantine_after', 'host_health_window',
+                'host_quarantine_period'):
         if ft.get(key) is not None:
             assert float(ft[key]) > 0, \
                 'fault_tolerance.%s must be > 0' % key
@@ -199,6 +221,14 @@ def validate(args: Dict[str, Any]) -> None:
         'inference.max_batch must be >= 1'
     assert int(inf.get('vault_size', 3)) >= 1, \
         'inference.vault_size must be >= 1'
+    assert int(inf.get('queue_max', 1024)) >= 0, \
+        'inference.queue_max must be >= 0 (0 = unbounded)'
+    assert int(inf.get('request_retries', 1)) >= 0, \
+        'inference.request_retries must be >= 0'
+    for key in ('stall_timeout', 'restart_max_delay', 'request_timeout',
+                'reprobe_initial_delay', 'reprobe_max_delay'):
+        if inf.get(key) is not None:
+            assert float(inf[key]) > 0, 'inference.%s must be > 0' % key
     if ta.get('batcher_shared_memory'):
         assert ta.get('batcher_processes'), \
             'batcher_shared_memory requires batcher_processes (the thread ' \

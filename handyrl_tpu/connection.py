@@ -287,15 +287,25 @@ HEARTBEAT_KIND = '__hb__'
 # Inference-service frames (inference.py): an engine-mode worker's
 # ``(INFER_KIND, request)`` rides its existing pipe to the host relay,
 # multiplexed by the relay's Hub event loop alongside the task RPCs; the
-# engine's reply is posted back through the same per-endpoint outbox. The
-# worker holds at most one request in flight, so the strict call-response
-# pairing of the 4-RPC protocol is preserved frame-for-frame.
+# engine's reply is posted back through the same per-endpoint outbox AS A
+# ``(INFER_KIND, reply)`` frame. Tagging replies matters for self-healing:
+# a worker that timed out on a request and failed over to local inference
+# may receive the engine's late answer at ANY later point — including in
+# the middle of an args/episode/model call-response — and must be able to
+# recognize and absorb it instead of mistaking it for the RPC's reply
+# (inference.EngineClient.rpc does exactly that, via ``is_infer``).
 INFER_KIND = '__infer__'
 
 
 def is_heartbeat(msg) -> bool:
     return (isinstance(msg, (list, tuple)) and len(msg) == 2
             and msg[0] == HEARTBEAT_KIND)
+
+
+def is_infer(msg) -> bool:
+    """True for an inference-service frame (request or reply)."""
+    return (isinstance(msg, (list, tuple)) and len(msg) == 2
+            and msg[0] == INFER_KIND)
 
 
 def _describe(endpoint) -> str:

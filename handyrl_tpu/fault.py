@@ -15,12 +15,23 @@ Three small pieces shared by the transport (connection.py), the actor tree
   ``num_results`` accounting converges instead of drifting when actors
   churn (the seed assigned tasks fire-and-forget, train.py:1523-1548).
 
+* :class:`FleetController` — the learner's per-host health state machine
+  (healthy / degraded / draining / quarantined), fed by ledger strandings
+  and heartbeat fault telemetry. It drives the elastic assignment policy:
+  flapping hosts stop receiving fresh tasks (drain-before-detach), sit out
+  a quarantine period, and are re-admitted afterwards.
+
 * :func:`parse_chaos` — the ``HANDYRL_TPU_CHAOS`` fault-injection knobs
   used by the chaos tests and available for soak runs:
   ``kill_gather=<mean s>`` (the worker host SIGKILLs a random gather child
   on an exponential clock), ``kill_worker=<mean s>`` (each worker process
   self-destructs after an exponentially distributed lifetime),
-  ``max_kills=<n>``, ``seed=<n>``.
+  ``max_kills=<n>``, ``seed=<n>``; plus the inference-tier injectors
+  ``enginekill=<mean s>`` (the host InferenceEngine thread crashes),
+  ``enginestall=<mean s>`` (the engine wedges mid-tick while holding
+  requests), ``enginestall_secs=<s>`` (length of an injected stall) and
+  ``engine_max_faults=<n>`` (per-process injection budget) — consumed by
+  ``inference.EngineSupervisor``.
 """
 
 from __future__ import annotations
@@ -78,6 +89,7 @@ class TaskLedger:
         self._tasks: Dict[int, tuple] = {}          # tid -> (endpoint, base, expires)
         self._by_endpoint: Dict[Any, set] = defaultdict(set)
         self._reissue: deque = deque()
+        self._strandings: deque = deque(maxlen=4096)  # (endpoint, reason, t)
         self._next_tid = 0
         self.stats: Dict[str, int] = {
             'assigned': 0, 'completed': 0, 'duplicates': 0,
@@ -125,7 +137,7 @@ class TaskLedger:
 
     # -- loss handling --
 
-    def _strand(self, tid):
+    def _strand(self, tid, reason: str = 'detach'):
         endpoint, base, _expires = self._tasks.pop(tid)
         owners = self._by_endpoint.get(endpoint)
         if owners is not None:
@@ -133,6 +145,7 @@ class TaskLedger:
             if not owners:
                 self._by_endpoint.pop(endpoint, None)
         self._reissue.append(base)
+        self._strandings.append((endpoint, reason, self._clock()))
         self.stats['reissued'] += 1
 
     def fail_endpoint(self, endpoint) -> int:
@@ -150,7 +163,7 @@ class TaskLedger:
         expired = [tid for tid, (_ep, _base, exp) in self._tasks.items()
                    if exp <= now]
         for tid in expired:
-            self._strand(tid)
+            self._strand(tid, reason='deadline')
         self.stats['expired'] += len(expired)
         return len(expired)
 
@@ -162,8 +175,175 @@ class TaskLedger:
     def outstanding(self) -> int:
         return len(self._tasks)
 
+    def outstanding_by_endpoint(self) -> Dict[Any, int]:
+        """Open task count per endpoint (the fleet controller's drain
+        policy waits on this before quarantining a flapping host)."""
+        return {ep: len(tids) for ep, tids in self._by_endpoint.items()
+                if tids}
+
     def pending_reissue(self) -> int:
         return len(self._reissue)
+
+    def drain_stranding_events(self):
+        """Consume the (endpoint, reason, time) stranding journal — one
+        entry per task that had to be re-issued, attributed to the endpoint
+        that lost it (the fleet controller's fault signal)."""
+        events = list(self._strandings)
+        self._strandings.clear()
+        return events
+
+
+# host health states, in escalation order (numeric codes for the
+# fleet_host_state gauge live in telemetry.HOST_STATE_CODES)
+HOST_HEALTHY = 'healthy'
+HOST_DEGRADED = 'degraded'
+HOST_DRAINING = 'draining'
+HOST_QUARANTINED = 'quarantined'
+
+
+class FleetController:
+    """Per-host health state machine for the learner's elastic fleet
+    control: decide, per task-assignment, whether a host should receive
+    fresh work — instead of only detecting death after the fact.
+
+    Inputs are two fault streams per host key:
+
+    * **strandings** — tasks the ledger had to re-issue because this host's
+      endpoint detached or blew its deadline (the hard signal);
+    * **soft faults** — engine restarts / worker failovers reported up the
+      heartbeat telemetry (the host self-healed, but it is struggling).
+
+    State machine (every host starts ``healthy``; all windows slide):
+
+    * ``healthy -> degraded`` — ≥ ``degrade_after`` fault signals of either
+      kind within ``health_window`` seconds. Degraded hosts still receive
+      tasks; the state exists to make trouble visible before it escalates.
+    * ``degraded -> healthy`` — a full quiet ``health_window``.
+    * ``healthy/degraded -> draining`` — ≥ ``quarantine_after`` STRANDINGS
+      within the window: the host is flapping. Draining stops fresh
+      assignments but lets booked tasks finish (drain-before-detach) —
+      in-flight episodes that can still land, land.
+    * ``draining -> quarantined`` — the host's outstanding book is empty
+      (completed or re-issued elsewhere). The quarantine clock starts.
+    * ``quarantined -> healthy`` — ``quarantine_period`` seconds later the
+      host is re-admitted with a cleared fault history (one fresh chance;
+      renewed flapping walks the same path with no special casing).
+
+    ``admits(host)`` is the assignment gate the server consults; draining
+    and quarantined hosts get 'idle' placeholder tasks instead of work.
+    Transitions are journaled for ``drain_transitions`` (the server logs
+    them and mirrors them onto ``fleet_host_state`` gauges).
+    """
+
+    def __init__(self, degrade_after: int = 1, quarantine_after: int = 3,
+                 health_window: float = 120.0,
+                 quarantine_period: float = 60.0, clock=time.time):
+        self.degrade_after = max(1, int(degrade_after))
+        self.quarantine_after = max(1, int(quarantine_after))
+        self.health_window = float(health_window)
+        self.quarantine_period = float(quarantine_period)
+        self._clock = clock
+        self._state: Dict[str, str] = {}
+        self._strands: Dict[str, deque] = defaultdict(deque)   # event times
+        self._softs: Dict[str, deque] = defaultdict(deque)
+        self._until: Dict[str, float] = {}          # quarantine expiry
+        self._transitions: deque = deque(maxlen=4096)
+        self.stats: Dict[str, int] = {
+            'degraded': 0, 'quarantined': 0, 'readmitted': 0, 'withheld': 0}
+
+    # -- queries -----------------------------------------------------------
+
+    def observe(self, host: str) -> bool:
+        """Register ``host`` (idempotent); True the first time."""
+        if host in self._state:
+            return False
+        self._state[host] = HOST_HEALTHY
+        return True
+
+    def state(self, host: str) -> str:
+        return self._state.get(host, HOST_HEALTHY)
+
+    def admits(self, host: str) -> bool:
+        """May ``host`` receive fresh task assignments right now?"""
+        return self.state(host) in (HOST_HEALTHY, HOST_DEGRADED)
+
+    def snapshot(self) -> Dict[str, str]:
+        return dict(self._state)
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in (HOST_HEALTHY, HOST_DEGRADED, HOST_DRAINING,
+                              HOST_QUARANTINED)}
+        for state in self._state.values():
+            out[state] += 1
+        return out
+
+    def drain_transitions(self):
+        """Consume the (host, from_state, to_state, time) journal."""
+        events = list(self._transitions)
+        self._transitions.clear()
+        return events
+
+    # -- fault feeds -------------------------------------------------------
+
+    def record_stranding(self, host: str, n: int = 1):
+        now = self._clock()
+        self._strands[host].extend([now] * max(1, int(n)))
+        self._reassess(host, now)
+
+    def record_soft_fault(self, host: str, n: int = 1):
+        now = self._clock()
+        self._softs[host].extend([now] * max(1, int(n)))
+        self._reassess(host, now)
+
+    # -- transitions -------------------------------------------------------
+
+    def _set(self, host: str, state: str):
+        prev = self._state.get(host, HOST_HEALTHY)
+        if prev == state:
+            return
+        self._state[host] = state
+        self._transitions.append((host, prev, state, self._clock()))
+
+    def _prune(self, host: str, now: float):
+        horizon = now - self.health_window
+        for dq in (self._strands[host], self._softs[host]):
+            while dq and dq[0] < horizon:
+                dq.popleft()
+
+    def _reassess(self, host: str, now: float):
+        self.observe(host)
+        self._prune(host, now)
+        state = self.state(host)
+        strands = len(self._strands[host])
+        faults = strands + len(self._softs[host])
+        if (state in (HOST_HEALTHY, HOST_DEGRADED)
+                and strands >= self.quarantine_after):
+            self._set(host, HOST_DRAINING)
+        elif state == HOST_HEALTHY and faults >= self.degrade_after:
+            self._set(host, HOST_DEGRADED)
+            self.stats['degraded'] += 1
+
+    def tick(self, outstanding: Optional[Dict[str, int]] = None):
+        """Time/drain-driven transitions; ``outstanding`` maps host key ->
+        open ledger tasks (a draining host quarantines once it hits 0)."""
+        now = self._clock()
+        outstanding = outstanding or {}
+        for host, state in list(self._state.items()):
+            if state == HOST_DRAINING:
+                if outstanding.get(host, 0) <= 0:
+                    self._until[host] = now + self.quarantine_period
+                    self._set(host, HOST_QUARANTINED)
+                    self.stats['quarantined'] += 1
+            elif state == HOST_QUARANTINED:
+                if now >= self._until.get(host, 0.0):
+                    self._strands[host].clear()
+                    self._softs[host].clear()
+                    self._set(host, HOST_HEALTHY)
+                    self.stats['readmitted'] += 1
+            elif state == HOST_DEGRADED:
+                self._prune(host, now)
+                if not self._strands[host] and not self._softs[host]:
+                    self._set(host, HOST_HEALTHY)
 
 
 def parse_chaos(spec: Optional[str] = None) -> Dict[str, float]:

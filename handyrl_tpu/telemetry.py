@@ -179,6 +179,13 @@ INGEST_STAGES: Tuple[str, ...] = (
 # engine_batch_rows): powers of two matching the padded dispatch buckets.
 BATCH_ROW_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+# Numeric encoding of the fleet controller's host health states
+# (fault.FleetController) for the per-host ``fleet_host_state`` gauge
+# family: monotone in severity, so operators can alert on `value >= 2`
+# (draining or quarantined = the host is not receiving fresh work).
+HOST_STATE_CODES: Dict[str, int] = {
+    'healthy': 0, 'degraded': 1, 'draining': 2, 'quarantined': 3}
+
 
 class Counter:
     """Monotonic labeled counter."""

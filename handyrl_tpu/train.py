@@ -47,7 +47,7 @@ import psutil
 from . import guard as guard_mod
 from . import telemetry
 from .environment import make_env, prepare_env
-from .fault import TaskLedger
+from .fault import FleetController, TaskLedger
 from .generation import BatchedEvaluator, BatchedGenerator
 from .model import ModelWrapper
 from .ops.batch import make_batch, select_episode
@@ -974,6 +974,7 @@ class Learner:
         self.use_batched_generation = (not remote
                                        and args.get('batched_generation', True))
         self.ledger: Optional[TaskLedger] = None   # built by server()
+        self.fleet: Optional[FleetController] = None   # built by server()
         self.worker = None
         if not self.use_batched_generation:
             self.worker = WorkerServer(args) if remote else WorkerCluster(args)
@@ -1972,12 +1973,89 @@ class Learner:
         ``num_episodes``/``num_results`` — so episode accounting converges
         and budgeted runs cannot hang waiting for episodes a dead host will
         never deliver. Duplicate uploads (a gather resending an un-acked
-        RPC after reconnect) are dropped by the same book."""
+        RPC after reconnect) are dropped by the same book.
+
+        On top of the ledger sits ELASTIC FLEET CONTROL
+        (:class:`~.fault.FleetController`): every peer endpoint maps to a
+        host key (socket peers by address — gathers on one machine share
+        one health record across reconnects; pipe peers individually), and
+        each host carries a health state (healthy / degraded / draining /
+        quarantined) fed by ledger strandings and by the engine-failover /
+        engine-restart counters riding heartbeat telemetry. Flapping hosts
+        stop receiving fresh tasks — they get 'idle' placeholders while
+        their booked work drains — sit out a quarantine, and are
+        re-admitted. State transitions are exported as per-host
+        ``fleet_host_state`` gauges, a transitions counter, the per-epoch
+        ``fleet:`` line, and ``fleet_host_states`` in metrics_jsonl."""
         _LOG.info('started server')
         cadence = _EpochCadence(self.args)
         ft = self.args.get('fault_tolerance') or {}
         ledger = self.ledger = TaskLedger(
             deadline=float(ft.get('task_deadline', 300.0)))
+        fleet = self.fleet = FleetController(
+            degrade_after=int(ft.get('host_degrade_after', 1)),
+            quarantine_after=int(ft.get('host_quarantine_after', 3)),
+            health_window=float(ft.get('host_health_window', 120.0)),
+            quarantine_period=float(ft.get('host_quarantine_period', 60.0)))
+        host_of: Dict[Any, str] = {}       # endpoint -> host key
+        fault_seen: Dict[Any, float] = {}  # endpoint -> fault counter mark
+        m_withheld = telemetry.counter('fleet_tasks_withheld_total')
+
+        def host_key(ep) -> str:
+            """Stable host identity for an endpoint: socket peers key by
+            address (a respawned/reconnected gather from the same machine
+            keeps its health history), pipe peers individually."""
+            key = host_of.get(ep)
+            if key is None:
+                try:
+                    sock = getattr(ep, 'sock', None)
+                    # a closed FramedConnection still has the attribute
+                    # with sock=None — that's a dead socket peer, not a pipe
+                    if sock is None and hasattr(ep, 'sock'):
+                        raise OSError('socket already closed')
+                    key = ('host-%s' % sock.getpeername()[0]
+                           if sock is not None
+                           else 'local-%d' % ep.fileno())
+                except (OSError, AttributeError):
+                    key = 'host-unknown'
+                host_of[ep] = key
+                if fleet.observe(key):
+                    telemetry.gauge('fleet_host_state', host=key).set(
+                        telemetry.HOST_STATE_CODES[fleet.state(key)])
+            return key
+
+        def pump_fleet_health():
+            """Feed the controller and mirror its transitions to metrics:
+            strandings from the ledger, soft faults (engine restarts and
+            worker failovers) from heartbeat telemetry deltas, then the
+            time/drain-driven transitions."""
+            for ep, _reason, _t in ledger.drain_stranding_events():
+                host = host_of.get(ep)
+                if host is not None:
+                    fleet.record_stranding(host)
+            for ep, info in self.worker.peer_info().items():
+                if not isinstance(info, dict) or ep not in host_of:
+                    continue
+                counters = (info.get('telemetry') or {}).get('counters') or {}
+                cur = sum(v for k, v in counters.items()
+                          if k.startswith(('engine_restarts_total',
+                                           'worker_engine_failovers_total')))
+                prev = fault_seen.get(ep, 0)
+                if cur > prev:   # < prev = the peer process restarted
+                    fleet.record_soft_fault(host_of[ep], cur - prev)
+                fault_seen[ep] = cur
+            outstanding: Dict[str, int] = {}
+            for ep, n in ledger.outstanding_by_endpoint().items():
+                host = host_of.get(ep)
+                if host is not None:
+                    outstanding[host] = outstanding.get(host, 0) + n
+            fleet.tick(outstanding)
+            for host, prev, state, _t in fleet.drain_transitions():
+                _LOG.warning('fleet: host %s %s -> %s', host, prev, state)
+                telemetry.gauge('fleet_host_state', host=host).set(
+                    telemetry.HOST_STATE_CODES[state])
+                telemetry.counter('fleet_host_transitions_total',
+                                  **{'from': prev, 'to': state}).inc()
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
             if self.preempt.requested():
@@ -1991,12 +2069,18 @@ class Learner:
             self._poll_rollback()
             # fleet supervision runs even when no RPC arrives: stranded
             # tasks must re-enter the queue or the epoch cadence starves
+            detached = []
             for ep, reason, _t in self.worker.drain_detach_events():
                 lost = ledger.fail_endpoint(ep)
+                detached.append(ep)
                 if lost:
                     _LOG.warning('re-issuing %d task(s) from detached '
                                  'peer (%s)', lost, reason)
             ledger.reap()
+            pump_fleet_health()
+            for ep in detached:       # after the stranding drain mapped them
+                host_of.pop(ep, None)
+                fault_seen.pop(ep, None)
             try:
                 conn, (req, data) = self.worker.recv(timeout=0.3)
             except queue.Empty:
@@ -2010,6 +2094,15 @@ class Learner:
             if req == 'args':
                 if self.shutdown_flag:
                     send_data = [None] * len(data)
+                elif not fleet.admits(host_key(conn)):
+                    # drain-before-detach: a draining/quarantined host gets
+                    # placeholder tasks — unbooked and uncounted — so its
+                    # workers stay warm for re-admission while its in-
+                    # flight work either lands or strands on the ledger
+                    fleet.stats['withheld'] += len(data)
+                    m_withheld.inc(len(data))
+                    send_data = [{'role': 'idle', 'wait': 1.0}
+                                 for _ in data]
                 else:
                     for _ in data:
                         role_args = ledger.next_reissue()
@@ -2111,6 +2204,17 @@ class Learner:
                    if k.startswith('disconnect_')}
         if reasons:
             snap['disconnects'] = reasons
+        if getattr(self, 'fleet', None) is not None:
+            counts = self.fleet.counts()
+            snap['hosts'] = sum(counts.values())
+            snap['hosts_degraded'] = counts['degraded']
+            snap['hosts_draining'] = counts['draining']
+            snap['hosts_quarantined'] = counts['quarantined']
+            snap['withheld'] = self.fleet.stats['withheld']
+            snap['readmitted'] = self.fleet.stats['readmitted']
+            # full per-host map: metrics_jsonl only (popped from the
+            # printed line, which carries the counts above)
+            snap['host_states'] = self.fleet.snapshot()
         return snap
 
     def _print_fleet_stats(self):
@@ -2121,6 +2225,7 @@ class Learner:
         snap['guard_nonfinite'] = self.trainer.guard.total_bad
         snap['guard_rollbacks'] = self.trainer.guard.rollbacks
         snap['guard_bad_episodes'] = self._bad_episodes
+        snap.pop('host_states', None)
         reasons = snap.pop('disconnects', {})
         line = ' '.join('%s=%s' % kv for kv in snap.items())
         if reasons:

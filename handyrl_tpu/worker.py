@@ -17,7 +17,11 @@ data connections on port 9998 all match the reference topology
   :class:`~.inference.InferenceEngine` that alone materializes snapshots
   and serves coalesced batched forward passes for every worker on the
   host — the 'model' RPC then flows learner -> gather -> engine only, so
-  model broadcast cost is O(hosts), not O(workers);
+  model broadcast cost is O(hosts), not O(workers). The engine is owned
+  through an :class:`~.inference.EngineSupervisor` (restart on crash or
+  stall, error fan-out) and workers degrade to the per-worker inference
+  path — losslessly, records stay byte-identical — when it is
+  unreachable, re-promoting once a probe succeeds;
 * the 'model' RPC ships an architecture-name + msgpack-params snapshot
   (model.ModelWrapper.snapshot), never pickled code, and socket frames are
   msgpack data — nothing on the public ports can execute on decode.
@@ -47,7 +51,8 @@ from .fault import Backoff, parse_chaos
 from .generation import Generator
 # ModelVault moved to inference.py (the engine shares it); re-exported here
 # for compatibility with existing imports
-from .inference import InferenceEngine, ModelVault, RemoteModelCache
+from .inference import (EngineClient, EngineSupervisor, InferenceEngine,
+                        ModelVault, RemoteModelCache)
 
 _LOG = telemetry.get_logger('worker')
 
@@ -79,10 +84,16 @@ class Worker:
         self._hb_next = time.time() + self._hb_interval
 
         inf = args.get('inference') or {}
+        self.client: Optional[EngineClient] = None
         if inf.get('enabled'):
-            # engine mode: this process never materializes params — models
-            # are wire proxies onto the host relay's InferenceEngine
-            self.vault = RemoteModelCache(conn)
+            # engine mode: this process materializes no params up front —
+            # models are wire proxies onto the host relay's InferenceEngine.
+            # The shared EngineClient owns request deadlines and the
+            # circuit-breaker failover to the per-worker path (at which
+            # point snapshots ARE materialized locally, via the same
+            # 'model' RPC — graceful degradation costs memory, not bytes).
+            self.client = EngineClient(conn, args, namespace=wid)
+            self.vault = RemoteModelCache(self.client)
         else:
             self.env.reset()
             example_obs = self.env.observation(self.env.players()[0])
@@ -111,6 +122,14 @@ class Worker:
                         {'worker': self.worker_id,
                          'telemetry': telemetry.snapshot()}))
 
+    def _rpc(self, msg):
+        """One blocking call-response on the gather pipe. In engine mode
+        the EngineClient filters out any late inference reply that would
+        otherwise be mistaken for this RPC's answer."""
+        if self.client is not None:
+            return self.client.rpc(msg)
+        return send_recv(self.conn, msg)
+
     def run(self):
         """Supervised task loop: a broken pipe to the gather ends the
         process (the gather's supervisor respawns the whole subtree), but a
@@ -130,13 +149,21 @@ class Worker:
                 os._exit(17)
             try:
                 self._maybe_heartbeat()
-                task = send_recv(self.conn, ('args', None))
+                task = self._rpc(('args', None))
             except _CONN_ERRORS:
                 _LOG.warning('worker %d: lost its gather; exiting',
                              self.worker_id)
                 return
             if task is None:
                 return
+            if task.get('role') == 'idle':
+                # elastic fleet control: the learner is withholding fresh
+                # tasks from this host (quarantined/draining) — nap and
+                # re-ask instead of exiting, so the host stays warm for
+                # re-admission
+                telemetry.counter('worker_idle_tasks_total').inc()
+                time.sleep(min(5.0, float(task.get('wait', 1.0))))
+                continue
             produce, upload_as = self.playbook[task['role']]
             t0 = time.perf_counter()
             try:
@@ -154,7 +181,7 @@ class Worker:
                 'worker_task_seconds', role=task['role']).observe(
                     time.perf_counter() - t0)
             try:
-                send_recv(self.conn, (upload_as, payload))
+                self._rpc((upload_as, payload))
             except _CONN_ERRORS:
                 return
 
@@ -249,14 +276,19 @@ class Gather:
         # as the main task loop: RPCs must not interleave on the wire
         self._rpc_lock = threading.RLock()
 
-        self.engine: Optional[InferenceEngine] = None
+        self.engine: Optional[EngineSupervisor] = None
         if (args.get('inference') or {}).get('enabled'):
             # per-host batched inference service: this relay alone pulls
             # model snapshots; its workers submit (mid, obs, hidden, legal)
-            # frames and receive sampled actions back over the same pipes
-            self.engine = InferenceEngine(
+            # frames and receive sampled actions back over the same pipes.
+            # The supervisor watchdogs the engine thread (restart on
+            # crash/stall, error fan-out so no reply is silently dropped);
+            # replies ride the pipe as (INFER_KIND, reply) frames so the
+            # worker's client can tell them from task-RPC answers.
+            self.engine = EngineSupervisor(
                 args, fetch_snapshot=self._snapshot,
-                reply_fn=self.hub.send, clients=n_here).start()
+                reply_fn=lambda ep, msg: self.hub.send(ep, (INFER_KIND, msg)),
+                clients=n_here)
 
     def __del__(self):
         _LOG.info('finished gather %d', self.gather_id)
@@ -400,9 +432,11 @@ class Gather:
                 self.hub.send(ep, self._snapshot(body))
             elif kind == INFER_KIND:
                 if self.engine is None:
-                    self.hub.send(ep, {'rid': (body or {}).get('rid'),
-                                       'error': 'inference engine disabled '
-                                                'on this host'})
+                    self.hub.send(ep, (INFER_KIND,
+                                       {'rid': (body or {}).get('rid'),
+                                        'engine_fault': True,
+                                        'error': 'inference engine disabled '
+                                                 'on this host'}))
                 else:
                     self.engine.submit(ep, body)
             else:

@@ -1,11 +1,17 @@
 """Chaos TCP proxy: a fault-injection man-in-the-middle for localhost tests.
 
 Forwards byte streams between clients and a target port, and can inject
-the three transport faults the fleet must survive:
+the four transport faults the fleet must survive:
 
 * ``sever()``   — hard-close every live connection (peers see RST/EOF);
 * ``blackhole`` — keep connections open but swallow all bytes (a silently
   dead peer: exactly the half-open-TCP case heartbeat liveness exists for);
+* ``stall``     — ONE-WAY blackhole: client->target bytes still flow (the
+  server accepts the request frames) but target->client bytes are
+  swallowed — the request was received, the reply never comes. This is the
+  stalled-service shape (a wedged inference engine, a hung RPC server)
+  that request deadlines and the engine watchdog exist for, distinct from
+  ``blackhole`` where not even the request arrives;
 * ``delay``     — per-chunk forwarding latency (slow WAN links).
 """
 
@@ -43,6 +49,7 @@ class ChaosProxy:
         self._lock = threading.Lock()
         self.accepting = True
         self.blackhole = False
+        self.stall = False
         self.delay = 0.0
         self.accepted = 0
         self._closed = False
@@ -66,10 +73,11 @@ class ChaosProxy:
             with self._lock:
                 self._conns.append((client, upstream))
             for src, dst in ((client, upstream), (upstream, client)):
-                threading.Thread(target=self._pump, args=(src, dst),
+                threading.Thread(target=self._pump,
+                                 args=(src, dst, src is upstream),
                                  daemon=True).start()
 
-    def _pump(self, src, dst):
+    def _pump(self, src, dst, from_target: bool):
         while True:
             try:
                 data = src.recv(1 << 16)
@@ -81,6 +89,8 @@ class ChaosProxy:
                 time.sleep(self.delay)
             if self.blackhole:
                 continue          # swallow silently: peer looks alive-but-mute
+            if self.stall and from_target:
+                continue          # request accepted, reply never comes
             try:
                 dst.sendall(data)
             except OSError:

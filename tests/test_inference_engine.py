@@ -6,6 +6,7 @@ canonicalization is exercised too."""
 
 import pickle
 import queue
+from collections import deque
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,8 +19,8 @@ from handyrl_tpu.environment import make_env
 from handyrl_tpu.generation import (Generator, masked_sample,
                                     masked_sample_batch, model_act,
                                     pad_to_bucket, sample_seed)
-from handyrl_tpu.inference import (InferenceEngine, ModelVault, RemoteModel,
-                                   RemoteModelCache)
+from handyrl_tpu.inference import (EngineClient, InferenceEngine, ModelVault,
+                                   RemoteModel, RemoteModelCache)
 from handyrl_tpu.model import ModelWrapper
 from handyrl_tpu.utils.tree import softmax
 
@@ -208,20 +209,34 @@ def test_remote_model_cache_semantics():
 
 class _Loopback:
     """In-process stand-in for the worker<->gather pipe: requests go
-    straight into a live engine; replies round-trip through pickle, which
-    simulates the mp transport (fresh dtype instances and all)."""
+    straight into a live engine; replies come back as the tagged
+    ``(INFER_KIND, reply)`` frames the real relay posts, round-tripped
+    through pickle to simulate the mp transport (fresh dtype instances and
+    all)."""
 
     def __init__(self, engine):
         self.engine = engine
         self.replies: queue.Queue = queue.Queue()
+        self._peeked: deque = deque()
 
     def send(self, msg):
         kind, body = msg
         assert kind == INFER_KIND
         self.engine.submit(self, pickle.loads(pickle.dumps(body)))
 
+    def poll(self, timeout=0.0):
+        if self._peeked:
+            return True
+        try:
+            self._peeked.append(self.replies.get(timeout=max(timeout, 1e-4)))
+        except queue.Empty:
+            return False
+        return True
+
     def recv(self):
-        return pickle.loads(pickle.dumps(self.replies.get(timeout=30)))
+        body = (self._peeked.popleft() if self._peeked
+                else self.replies.get(timeout=30))
+        return (INFER_KIND, pickle.loads(pickle.dumps(body)))
 
 
 def _engine_for(snapshot_by_mid, example_obs, clients=1, batch_wait_ms=2.0,
@@ -236,6 +251,16 @@ def _engine_for(snapshot_by_mid, example_obs, clients=1, batch_wait_ms=2.0,
     return engine.start()
 
 
+def _remote(engine, mid, failover=False, **inf):
+    """RemoteModel over a fresh EngineClient + loopback pipe. ``failover``
+    defaults OFF so engine errors raise (the pre-self-healing semantics
+    most of these tests pin); the failover tests flip it on."""
+    args = {'inference': {'enabled': True, 'request_timeout': 30.0,
+                          'request_retries': 0, 'failover': failover, **inf},
+            'env': {'env': 'TicTacToe'}}
+    return RemoteModel(EngineClient(_Loopback(engine), args), mid)
+
+
 @pytest.mark.timeout(120)
 def test_engine_coalesces_across_clients():
     env, w = _ttt_wrapper()
@@ -243,8 +268,7 @@ def test_engine_coalesces_across_clients():
     engine = _engine_for({1: w.snapshot()}, obs, clients=4,
                          batch_wait_ms=500.0)
     try:
-        conns = [_Loopback(engine) for _ in range(4)]
-        models = [RemoteModel(c, 1) for c in conns]
+        models = [_remote(engine, 1) for _ in range(4)]
         rids = [m.act_send(obs, None, [0, 1, 2],
                            sample_seed(11, (0, k), 0))
                 for k, m in enumerate(models)]
@@ -269,7 +293,7 @@ def test_engine_act_matches_local_path_bitwise():
     obs = env.observation(0)
     engine = _engine_for({1: w.snapshot()}, obs)
     try:
-        remote = RemoteModel(_Loopback(engine), 1)
+        remote = _remote(engine, 1)
         legal = env.legal_actions(0)
         for draw in range(5):
             seed_seq = sample_seed(11, (0, 9), draw)
@@ -296,7 +320,7 @@ def test_engine_recurrent_hidden_round_trip():
     wrapper.ensure_params(obs)
     engine = _engine_for({1: wrapper.snapshot()}, obs)
     try:
-        remote = RemoteModel(_Loopback(engine), 1)
+        remote = _remote(engine, 1)
         h_local = wrapper.init_hidden()       # real initial state
         h_remote = remote.init_hidden()       # None by design
         assert h_remote is None
@@ -331,10 +355,10 @@ def test_engine_error_reply_does_not_kill_service():
                              reply_fn=lambda ep, msg: ep.replies.put(msg),
                              clients=1, example_obs=obs).start()
     try:
-        bad = RemoteModel(_Loopback(engine), 99)
+        bad = _remote(engine, 99)
         with pytest.raises(RuntimeError, match='no such snapshot'):
             bad.act(obs, None, [0], sample_seed(0, (0, 0), 0))
-        good = RemoteModel(_Loopback(engine), 1)   # service still alive
+        good = _remote(engine, 1)   # service still alive
         rep = good.act(obs, None, [0, 1], sample_seed(0, (0, 1), 0))
         assert rep['action'] in (0, 1)
     finally:
@@ -347,7 +371,7 @@ def test_engine_random_model_id_zero_uniform():
     obs = env.observation(0)
     engine = _engine_for({0: w.snapshot()}, obs)
     try:
-        remote = RemoteModel(_Loopback(engine), 0)
+        remote = _remote(engine, 0)
         legal = [2, 5, 7]
         rep = remote.act(obs, None, legal, sample_seed(1, (0, 0), 0))
         assert rep['action'] in legal
@@ -378,7 +402,7 @@ def test_episode_records_bit_identical_across_paths():
 
     engine = _engine_for({1: snap}, env.observation(0))
     try:
-        remote = RemoteModel(_Loopback(engine), 1)
+        remote = _remote(engine, 1)
         eng_env = make_env({'env': 'TicTacToe'})
         eng = Generator(eng_env, GEN_ARGS, namespace=3)  # namespace ignored
         episodes_engine = [eng.generate({0: remote, 1: remote},
