@@ -364,16 +364,17 @@ def _measure_ingest(build_fn, episodes, args, n_batches, timer=None):
     batcher = Batcher(args, deque(episodes), timer=timer, build_fn=build_fn)
     batcher.run()
 
-    def stage_one():
+    def next_batch():
+        # with tracing on the thread batcher wraps batches in TracedBatch
         nxt = batcher.batch(timeout=60)
-        dev = jax.tree_util.tree_map(jnp.asarray, nxt)
-        jax.block_until_ready(dev)
-        return dev
+        return nxt.batch if hasattr(nxt, 'trace_ids') else nxt
 
-    stage_one()                      # warmup: thread spin-up, allocators
+    nxt = next_batch()               # warmup: thread spin-up, allocators
+    dev = jax.tree_util.tree_map(jnp.asarray, nxt)
+    jax.block_until_ready(dev)
     t0 = time.time()
     for _ in range(n_batches):
-        nxt = batcher.batch(timeout=60)
+        nxt = next_batch()
         th = time.time()
         dev = jax.tree_util.tree_map(jnp.asarray, nxt)
         jax.block_until_ready(dev)
@@ -417,12 +418,28 @@ def run_ingest(probe: dict):
               make_batch_reference(sel, a))
     timer = StageTimer()
     import contextlib
+    import shutil
+    import tempfile
+    trace_rate = float(os.environ.get('BENCH_TRACE_RATE', '0.1'))
+    trace_dir = tempfile.mkdtemp(prefix='bench_trace.')
     with contextlib.redirect_stdout(sys.stderr):
         # batcher-thread startup prints must not break the one-JSON-line
         # stdout contract
         ref_bps = _measure_ingest(ref_fn, episodes, args, n_batches)
         new_bps = _measure_ingest(make_batch, episodes, args, n_batches,
                                   timer=timer)
+        # tracing-off vs tracing-on(sampled) pair: the disabled-path cost
+        # claim ("near-zero when off") is guarded by the headline value
+        # above staying the headline; this third leg measures the SAME
+        # pipeline with episode tracing live at the sampled rate so a
+        # regression in either path shows up in benchmarks.jsonl
+        telemetry.configure_tracing(trace_dir, trace_rate, force=True)
+        try:
+            traced_bps = _measure_ingest(make_batch, episodes, args,
+                                         n_batches)
+        finally:
+            telemetry.configure_tracing('', None, force=True)
+            shutil.rmtree(trace_dir, ignore_errors=True)
 
     default_geom = (B == 128 and T == 16)
     # stage keys in the canonical telemetry order (telemetry.INGEST_STAGES
@@ -440,6 +457,10 @@ def run_ingest(probe: dict):
          vs_baseline_def=('arena builder / reference builder, identical '
                           'Batcher machinery'),
          stages=stages, run_id=telemetry.run_id(),
+         tracing_on_batches_per_sec=round(traced_bps, 2),
+         tracing_overhead_pct=round(
+             100.0 * (1.0 - traced_bps / new_bps), 2) if new_bps else 0.0,
+         trace_sample_rate=trace_rate,
          geometry=('headline' if default_geom else 'dryrun'))
 
 

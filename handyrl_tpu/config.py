@@ -114,8 +114,15 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
 
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
+    # + episode-lifecycle distributed tracing. Accepts a bool (legacy
+    # collection switch) or a block:
+    #   telemetry: {enabled: true, trace_dir: traces/, trace_sample_rate: 0.1}
+    # trace_dir (or HANDYRL_TPU_TRACE=<dir>, which wins) turns on Chrome-
+    # trace span export across every fleet process; trace_sample_rate keeps
+    # a deterministic fraction of episodes so overhead stays bounded.
     'telemetry': True,            # collect metrics (near-zero cost off; also HANDYRL_TPU_TELEMETRY=0)
-    'telemetry_port': 0,          # serve Prometheus text format on this port (0 = exporter off)
+    'telemetry_port': 0,          # serve Prometheus text format on this port (0 = exporter off; a busy port retries then falls back to an ephemeral one, logged)
+    'profile_epochs': '',         # epochs to wrap in a jax.profiler device trace ('3', '2,5', '3-5'); written to <trace_dir|model_dir>/profile unless profile_dir is set
 
     'batcher_processes': False,   # build batches in spawned CPU processes instead of threads
     'decode_cache_blocks': 1024,  # LRU capacity (bz2 blocks) of the batchers' decoded-moment cache; recency-biased selection re-decodes the same blocks every batch without it. 0 disables; memory cost ~= blocks * compress_steps * per-moment bytes
@@ -129,6 +136,28 @@ WORKER_DEFAULTS: Dict[str, Any] = {
     'server_address': '',
     'num_parallel': 8,
 }
+
+
+def parse_epoch_set(spec) -> set:
+    """Parse the ``profile_epochs`` knob: an int, a list of ints, or a
+    comma-separated string accepting ranges ('3', '2,5', '3-5,8')."""
+    if not spec:
+        return set()
+    if isinstance(spec, int):
+        return {int(spec)}
+    if isinstance(spec, (list, tuple)):
+        return {int(x) for x in spec}
+    out: set = set()
+    for part in str(spec).split(','):
+        part = part.strip()
+        if not part:
+            continue
+        if '-' in part and not part.startswith('-'):
+            lo, _, hi = part.partition('-')
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(part))
+    return out
 
 
 def _merge(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
@@ -203,11 +232,25 @@ def validate(args: Dict[str, Any]) -> None:
         'guard.rollback_after must be >= 1'
     assert float(g.get('loss_spike_zscore', 0.0)) >= 0, \
         'guard.loss_spike_zscore must be >= 0 (0 disables the trip)'
+    tel = ta.get('telemetry', True)
+    assert isinstance(tel, (bool, dict)), \
+        'telemetry must be a bool or a block (enabled / trace_dir / ' \
+        'trace_sample_rate)'
+    tel_enabled = bool(tel.get('enabled', True)) if isinstance(tel, dict) \
+        else bool(tel)
+    if isinstance(tel, dict):
+        rate = float(tel.get('trace_sample_rate', 1.0))
+        assert 0.0 <= rate <= 1.0, \
+            'telemetry.trace_sample_rate must be a fraction in [0, 1]'
+    if ta.get('profile_epochs'):
+        epochs = parse_epoch_set(ta['profile_epochs'])
+        assert epochs and all(e >= 1 for e in epochs), \
+            "profile_epochs must name epochs >= 1 ('3', '2,5', '3-5')"
     if ta.get('telemetry_port') is not None:
         port = int(ta['telemetry_port'])
         assert 0 <= port <= 65535, \
             'telemetry_port must be a TCP port (0 disables the exporter)'
-        assert port == 0 or ta.get('telemetry', True), \
+        assert port == 0 or tel_enabled, \
             'telemetry_port needs telemetry enabled (the exporter serves ' \
             'the registry the collection switch turns off)'
     assert 1 <= int(ta.get('compress_level', 9)) <= 9, \

@@ -26,6 +26,7 @@ import random
 import time
 from typing import Any, Dict, List, Optional
 
+from . import telemetry
 from .agent import Agent, EnsembleAgent, RandomAgent, RuleBasedAgent, SoftAgent
 from .connection import (accept_socket_connections, connect_socket_connection,
                          force_cpu_backend, send_recv)
@@ -259,7 +260,9 @@ class Evaluator:
                   else self._opponent_agent(opponent)
                   for p, model in models.items()}
 
-        results = exec_match(self.env, agents)
+        with telemetry.trace_span(
+                'evaluate', trace_id=telemetry.episode_trace_id(eval_args)):
+            results = exec_match(self.env, agents)
         if results is None:
             print('None episode in evaluation!')
             return None

@@ -43,6 +43,8 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Dict, Optional
 
+from . import telemetry
+
 
 class Backoff:
     """Exponential backoff with jitter: delays double from ``initial`` up to
@@ -107,6 +109,13 @@ class TaskLedger:
         self._tasks[tid] = (endpoint, base, self._clock() + self.deadline)
         self._by_endpoint[endpoint].add(tid)
         self.stats['assigned'] += 1
+        if telemetry.trace_enabled():
+            # the trace context is born here: the server-stamped sample_key
+            # becomes the trace_id every later hop derives independently
+            ttid = telemetry.episode_trace_id(role_args)
+            if ttid:
+                telemetry.trace_event('task_assign', trace_id=ttid,
+                                      task_id=tid)
         return tid
 
     def complete(self, tid) -> bool:
@@ -124,15 +133,24 @@ class TaskLedger:
         return True
 
     def admit(self, items):
-        """Filter an upload batch through the book (see class docstring)."""
+        """Filter an upload batch through the book (see class docstring).
+        Each admitted booked item also closes its trace chain's delivery
+        hop: an ``ingest`` trace event stamped with the shared trace_id."""
         out = []
+        tracing = telemetry.trace_enabled()
         for item in items:
             if item is None:            # failed episode: deadline re-issues it
                 out.append(item)
                 continue
-            tid = (item.get('args') or {}).get('task_id')
+            args = item.get('args') or {}
+            tid = args.get('task_id')
             if tid is None or self.complete(tid):
                 out.append(item)
+                if tracing and tid is not None:
+                    ttid = telemetry.episode_trace_id(args)
+                    if ttid:
+                        telemetry.trace_event('ingest', trace_id=ttid,
+                                              task_id=tid)
         return out
 
     # -- loss handling --

@@ -285,7 +285,14 @@ class Generator:
         return _finalize_episode(self.env, moments, self.args, gen_args)
 
     def execute(self, models, gen_args) -> Optional[dict]:
-        episode = self.generate(models, gen_args)
+        # episode-lifecycle tracing: the whole env-stepping span, keyed by
+        # the trace_id derived from the server-stamped task — the worker-
+        # side hop of the task_assign -> generate -> upload -> ingest ->
+        # train_step chain (docs/observability.md "Tracing")
+        with telemetry.trace_span(
+                'generate', trace_id=telemetry.episode_trace_id(gen_args),
+                worker=self.namespace):
+            episode = self.generate(models, gen_args)
         if episode is None:
             telemetry.get_logger('generation').warning(
                 'None episode in generation!')

@@ -730,6 +730,13 @@ class InferenceEngine:
             self._current = []
 
     def _serve(self, mid: int, group: List[tuple]):
+        # rate-sampled batch-level span in the episode trace (plus the
+        # stage_seconds{stage=engine_batch} histogram): one span per
+        # coalesced forward batch, sized for the critical-path report
+        with telemetry.trace_span('engine_batch', rows=len(group), mid=mid):
+            self._serve_group(mid, group)
+
+    def _serve_group(self, mid: int, group: List[tuple]):
         self._ensure_vault()
         model = self.vault.model(mid)
         reqs = [req for _ep, req, _t in group]

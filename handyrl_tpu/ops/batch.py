@@ -99,6 +99,10 @@ def select_episode(episodes: Sequence[dict], args: Dict[str, Any]) -> dict:
         'moment': ep['moment'][st_block:ed_block],
         'base': st_block * cs,
         'start': st, 'end': ed, 'train_start': train_st, 'total': ep['steps'],
+        # learner ingest timestamp (stamped by feed_episodes): selection is
+        # the consumption point, so the batcher can histogram sample age
+        # (policy-lag accounting, docs/observability.md)
+        'recv_time': ep.get('recv_time'),
     }
 
 

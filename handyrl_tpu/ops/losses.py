@@ -293,7 +293,19 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
 
     losses, dcnt = compose_losses(outputs, log_t, total_advantages, targets,
                                   batch, cfg)
-    aux = {'losses': losses, 'data_count': dcnt}
+    # off-policy health diagnostics, summed over acting (step, player)
+    # pairs like every loss term so the host normalizes by data_count:
+    # V-Trace rho/c clip fractions and the importance-ratio first/second
+    # moments (mean/std of the behavior->target ratio). They ride the
+    # update step's existing lazy metric fetch — no extra device sync.
+    tmask = batch['turn_mask']
+    diag = {
+        'rho_clip': ((rhos > clip_rho) * tmask).sum(),
+        'c_clip': ((rhos > clip_c) * tmask).sum(),
+        'rho_sum': (rhos * tmask).sum(),
+        'rho_sq_sum': (jnp.square(rhos) * tmask).sum(),
+    }
+    aux = {'losses': losses, 'data_count': dcnt, 'diag': diag}
     if new_bs is not None:
         aux['batch_stats'] = new_bs
     return losses['total'], aux

@@ -200,13 +200,19 @@ class SharedBatch:
     been fully read (for the trainer: after the staged device transfer is
     ready) — the child blocks on slot exhaustion, it never overwrites a
     slot that has not been freed.
+
+    ``trace_ids`` carries the sampled episode trace ids of the windows the
+    child assembled into this slot (ridden over the descriptor when episode
+    tracing is on), so the trainer's ``train_step`` trace event can link
+    back to the episodes it consumed.
     """
 
-    __slots__ = ('batch', '_release')
+    __slots__ = ('batch', '_release', 'trace_ids')
 
-    def __init__(self, batch: Dict[str, Any], release_fn):
+    def __init__(self, batch: Dict[str, Any], release_fn, trace_ids=None):
         self.batch = batch
         self._release = release_fn
+        self.trace_ids = trace_ids
 
     def release(self):
         fn, self._release = self._release, None

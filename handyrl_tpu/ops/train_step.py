@@ -100,9 +100,10 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
         # plus nonfinite=1; the host reads that flag on its existing lazy
         # metric fetch (no extra sync) and escalates per guard policy
         # (guard.py: skip / rollback / abort).
+        grad_norm = optax.global_norm(grads)
         ok = (jnp.isfinite(lr)
               & jnp.isfinite(aux['losses']['total'])
-              & jnp.isfinite(optax.global_norm(grads)))
+              & jnp.isfinite(grad_norm))
         updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
         updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(trainable, updates)
@@ -116,6 +117,13 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
                       'batch_stats': jax.tree_util.tree_map(
                           keep, new_bs, batch_stats)}
         metrics = {**aux['losses'], 'data_count': aux['data_count']}
+        # learning-dynamics diagnostics ride the same packed fetch under a
+        # 'diag_' prefix: the host routes them to the per-epoch dynamics
+        # summary instead of the reference-format loss line. grad_norm is
+        # the post-psum GLOBAL gradient (per update, not per sample).
+        for k, v in (aux.get('diag') or {}).items():
+            metrics['diag_' + k] = v
+        metrics['diag_grad_norm'] = grad_norm
         metrics = {k: jnp.where(ok, v, jnp.zeros_like(v))
                    for k, v in metrics.items()}
         metrics['nonfinite'] = 1.0 - ok.astype(jnp.float32)

@@ -29,20 +29,42 @@ throughput go across processes). Four pieces, all stdlib-only:
 * **TelemetryExporter** — optional Prometheus-text-format HTTP endpoint
   (stdlib http.server; ``telemetry_port`` config knob, off by default)
   serving the learner's local registry plus the latest merged fleet
-  snapshot.
+  snapshot. A busy port is retried and then falls back to an ephemeral
+  one — an occupied port must never take the learner down.
+
+* **Distributed tracing** — episode-lifecycle spans across the whole fleet
+  (``HANDYRL_TPU_TRACE=<dir>`` or the ``telemetry.trace_dir`` knob). Every
+  process appends Chrome-trace "complete" events (wall-clock microseconds,
+  pid/tid, ``args.trace_id``) to ONE shared JSONL per run via single
+  ``O_APPEND`` writes; the learner collates a valid Chrome/Perfetto JSON at
+  shutdown and ``scripts/trace_report.py`` reduces either file to a
+  generation→gradient critical-path summary. The trace context is the
+  ``trace_id`` derived from the server-stamped task (``role`` +
+  ``sample_key``): it rides the existing task/episode payloads through
+  every hop — no new wire fields — so spans from the learner (task_assign,
+  ingest, train_step), the gather (upload, engine_batch) and the workers
+  (generate) link up by id. Sampling is DETERMINISTIC per trace_id
+  (``telemetry.trace_sample_rate``): every process makes the same keep/drop
+  decision for an episode without coordination. Span durations also land in
+  the ``stage_seconds{stage=...}`` histogram family, so the trace file, the
+  metrics registry and the timing lines share one stage vocabulary. Off
+  (the default) every trace call is a single falsy-string check.
 """
 
 from __future__ import annotations
 
+import atexit
 import bisect
 import json
 import logging
 import os
+import random
 import re
 import sys
 import threading
 import time
 import uuid
+import zlib
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -82,6 +104,255 @@ def set_run_id(rid: Optional[str]):
     if rid:
         _RUN_ID = str(rid)
         os.environ['HANDYRL_TPU_RUN_ID'] = _RUN_ID
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing (Chrome-trace events over one shared per-run JSONL)
+
+# Default per-config knobs for the ``telemetry`` block (a bare bool in the
+# config is accepted as {'enabled': <bool>} for back-compat).
+TELEMETRY_DEFAULTS: Dict[str, Any] = {
+    'enabled': True, 'trace_dir': '', 'trace_sample_rate': 1.0}
+
+
+def config_block(args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Normalize the ``telemetry`` config knob: bool (legacy collection
+    switch) or a block with ``enabled`` / ``trace_dir`` /
+    ``trace_sample_rate``."""
+    raw = (args or {}).get('telemetry', True)
+    if isinstance(raw, dict):
+        out = dict(TELEMETRY_DEFAULTS)
+        out.update(raw)
+        return out
+    return {**TELEMETRY_DEFAULTS, 'enabled': bool(raw)}
+
+
+class _TraceState:
+    """Per-process trace sink: destination dir, sample rate, event buffer."""
+
+    def __init__(self):
+        self.dir = os.environ.get('HANDYRL_TPU_TRACE', '').strip()
+        rate = os.environ.get('HANDYRL_TPU_TRACE_RATE', '').strip()
+        try:
+            self.rate = min(1.0, max(0.0, float(rate))) if rate else 1.0
+        except ValueError:
+            self.rate = 1.0
+        self.label = 'proc'
+        self.lock = threading.Lock()
+        self.buf: List[str] = []
+        self.meta_done = False
+
+
+_TRACE = _TraceState()
+_TRACE_FLUSH_AT = 128      # buffered events per O_APPEND write
+
+
+def trace_enabled() -> bool:
+    return bool(_TRACE.dir)
+
+
+def trace_dir() -> str:
+    return _TRACE.dir
+
+
+def trace_sample_rate() -> float:
+    return _TRACE.rate
+
+
+def configure_tracing(trace_dir: Optional[str] = None,
+                      sample_rate: Optional[float] = None,
+                      force: bool = False):
+    """Adopt trace settings from the run config, mirrored into the
+    environment so spawned children (batchers, gathers, workers) inherit
+    them. An operator-set ``HANDYRL_TPU_TRACE`` / ``HANDYRL_TPU_TRACE_RATE``
+    wins over config values unless ``force`` (tests, bench A/B runs)."""
+    if sample_rate is not None and (force or
+                                    not os.environ.get('HANDYRL_TPU_TRACE_RATE')):
+        _TRACE.rate = min(1.0, max(0.0, float(sample_rate)))
+        os.environ['HANDYRL_TPU_TRACE_RATE'] = '%g' % _TRACE.rate
+    if trace_dir is not None and (force or
+                                  not os.environ.get('HANDYRL_TPU_TRACE')):
+        trace_flush()
+        _TRACE.dir = str(trace_dir).strip()
+        _TRACE.meta_done = False
+        os.environ['HANDYRL_TPU_TRACE'] = _TRACE.dir
+
+
+def set_process_label(label: str):
+    """Human-readable process name for the trace viewer's process rows
+    (learner / gather-N / worker-N / batcher-N)."""
+    _TRACE.label = str(label)
+
+
+def adopt_config(args: Optional[Dict[str, Any]]):
+    """One call for every process that receives the merged run config:
+    run id, the collection switch, and the trace destination/sampling."""
+    args = args or {}
+    set_run_id(args.get('run_id'))
+    tel = config_block(args)
+    if not tel.get('enabled', True):
+        set_enabled(False)
+    configure_tracing(tel.get('trace_dir') or None,
+                      tel.get('trace_sample_rate'))
+
+
+def episode_trace_id(task_args: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The trace context: derived from the server-stamped task identity
+    (``role`` + ``sample_key``), so every process holding the task or an
+    episode/result payload built from it computes the SAME id with no new
+    wire fields. None when the payload carries no sample_key (local
+    fallback streams, pre-ledger peers)."""
+    if not isinstance(task_args, dict):
+        return None
+    skey = task_args.get('sample_key')
+    if skey is None:
+        return None
+    return '%s%d' % (str(task_args.get('role') or 'g'), int(skey))
+
+
+def trace_sampled(trace_id) -> bool:
+    """Deterministic keep/drop for one episode: hash-based on the trace_id,
+    so the learner, gather and worker agree without coordination."""
+    if not _TRACE.dir:
+        return False
+    rate = _TRACE.rate
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (zlib.crc32(str(trace_id).encode()) % 10000) < rate * 10000
+
+
+def _emit_locked(line: str):
+    if not _TRACE.meta_done:
+        _TRACE.meta_done = True
+        _TRACE.buf.append(json.dumps(
+            {'name': 'process_name', 'ph': 'M', 'pid': os.getpid(), 'tid': 0,
+             'args': {'name': '%s-%d' % (_TRACE.label, os.getpid())}}))
+    _TRACE.buf.append(line)
+    if len(_TRACE.buf) >= _TRACE_FLUSH_AT:
+        _flush_locked()
+
+
+def trace_event(name: str, ts: Optional[float] = None, dur: float = 0.0,
+                trace_id=None, always: bool = False, **args):
+    """Record one Chrome-trace complete event ("ph": "X"; instants are
+    zero-duration spans). ``ts``/``dur`` are wall-clock seconds (converted
+    to the microseconds the viewers expect — wall time, so events align
+    across processes). Sampling: a truthy ``trace_id`` decides
+    deterministically; ``always`` bypasses (callers who already sampled);
+    otherwise batch-level events sample probabilistically at the same
+    rate."""
+    if not _TRACE.dir:
+        return
+    if trace_id:
+        if not trace_sampled(trace_id):
+            return
+        args['trace_id'] = trace_id
+    elif not always:
+        rate = _TRACE.rate
+        if rate < 1.0 and random.random() >= rate:
+            return
+    args['run_id'] = _RUN_ID
+    try:
+        tid = threading.get_native_id()
+    except AttributeError:
+        tid = threading.get_ident() & 0x7FFFFFFF
+    ev = {'name': name, 'cat': 'handyrl', 'ph': 'X',
+          'ts': int((time.time() if ts is None else ts) * 1e6),
+          'dur': max(0, int(dur * 1e6)),
+          'pid': os.getpid(), 'tid': tid, 'args': args}
+    with _TRACE.lock:
+        _emit_locked(json.dumps(ev))
+
+
+@contextmanager
+def trace_span(name: str, trace_id=None, **args):
+    """Timed section: always folded into the ``stage_seconds{stage=...}``
+    histogram family; additionally written to the trace file when tracing
+    is on (and the id — or the rate, for id-less spans — samples it)."""
+    t_wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        REGISTRY.observe_stage(name, dt)
+        if _TRACE.dir:
+            trace_event(name, ts=t_wall, dur=dt, trace_id=trace_id, **args)
+
+
+def trace_stage(stage: str, seconds: float, count: int = 1):
+    """Batch-level stage event (the StageTimer mirror): one span covering
+    the just-finished timed section, rate-sampled."""
+    if not _TRACE.dir:
+        return
+    trace_event(stage, ts=time.time() - seconds, dur=seconds, count=count)
+
+
+def _flush_locked():
+    buf = _TRACE.buf
+    if not buf or not _TRACE.dir:
+        return
+    _TRACE.buf = []
+    try:
+        os.makedirs(_TRACE.dir, exist_ok=True)
+        path = os.path.join(_TRACE.dir, 'trace-%s.jsonl' % _RUN_ID)
+        data = ('\n'.join(buf) + '\n').encode()
+        # one O_APPEND write per flush: complete lines, atomic offset —
+        # every fleet process appends to the same per-run file safely
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass   # tracing must never take the run down
+
+
+def trace_flush():
+    if not _TRACE.dir:
+        return
+    with _TRACE.lock:
+        _flush_locked()
+
+
+atexit.register(trace_flush)
+
+
+def finalize_trace() -> Optional[str]:
+    """Collate this run's JSONL event stream into a valid Chrome-trace /
+    Perfetto JSON file (``<dir>/trace-<run_id>.json``); returns the path
+    (None when tracing is off or nothing was recorded). Written atomically
+    (temp + rename); the JSONL stays the append-forever source of truth."""
+    if not _TRACE.dir:
+        return None
+    trace_flush()
+    src = os.path.join(_TRACE.dir, 'trace-%s.jsonl' % _RUN_ID)
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(src) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue   # torn tail line from a killed process
+    except OSError:
+        return None
+    if not events:
+        return None
+    out = os.path.join(_TRACE.dir, 'trace-%s.json' % _RUN_ID)
+    tmp = out + '.tmp'
+    try:
+        with open(tmp, 'w') as f:
+            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        os.replace(tmp, out)
+    except OSError:
+        return None
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -178,6 +449,21 @@ INGEST_STAGES: Tuple[str, ...] = (
 # Row-count buckets for batching histograms (e.g. the inference engine's
 # engine_batch_rows): powers of two matching the padded dispatch buckets.
 BATCH_ROW_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+# Policy-lag buckets: how many epochs behind the learner the params that
+# generated a consumed sample were (the policy_lag_epochs histogram).
+LAG_EPOCH_BUCKETS: Tuple[float, ...] = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32,
+                                        48, 64)
+
+# Sample-age buckets (seconds from learner ingest to consumption): buffer
+# dwell spans far past the latency-oriented DEFAULT_BUCKETS.
+AGE_SECOND_BUCKETS: Tuple[float, ...] = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25,
+                                         50, 100, 250, 500, 1000)
+
+# XLA compile durations (jax.monitoring events): seconds, up to the
+# minutes-long recurrent-net compiles.
+COMPILE_SECOND_BUCKETS: Tuple[float, ...] = (0.01, 0.05, 0.1, 0.25, 0.5, 1,
+                                             2.5, 5, 10, 30, 60, 120, 300)
 
 # Numeric encoding of the fleet controller's host health states
 # (fault.FleetController) for the per-host ``fleet_host_state`` gauge
@@ -402,12 +688,18 @@ def merge_snapshots(snaps: List[Optional[Dict[str, Any]]]
     Merge semantics: counters SUM (flows add across processes), gauges SUM
     (queue depths and rates add; per-peer resolution survives via labels —
     e.g. ``gather_episodes_per_sec{gather="3"}`` keys stay distinct),
-    histogram buckets ADD elementwise when bounds agree (a peer running
-    different bounds is skipped for that key rather than mis-binned).
+    histogram buckets ADD elementwise when bounds agree. A peer whose
+    bounds DISAGREE for a key is dropped for that key (never mis-binned)
+    and the drop is counted: once in the merged
+    ``telemetry_hist_bound_conflicts_total`` counter (so the conflict
+    survives re-merging up the fleet tree and reaches the exposition) and
+    once in the top-level ``hist_bound_conflicts`` field of the returned
+    snapshot.
     """
     out: Dict[str, Any] = {'run_id': _RUN_ID, 'time': time.time(),
                            'counters': {}, 'gauges': {}, 'hists': {},
                            'peers': 0}
+    conflicts = 0
     for snap in snaps:
         if not isinstance(snap, dict):
             continue
@@ -428,6 +720,16 @@ def merge_snapshots(snaps: List[Optional[Dict[str, Any]]]
                                   zip(cur['buckets'], h['buckets'])]
                 cur['sum'] += float(h['sum'])
                 cur['count'] += int(h['count'])
+            else:
+                conflicts += 1
+    if conflicts:
+        key = 'telemetry_hist_bound_conflicts_total'
+        out['counters'][key] = out['counters'].get(key, 0) + conflicts
+        out['hist_bound_conflicts'] = conflicts
+        get_logger('telemetry').warning(
+            'merge_snapshots: dropped %d histogram(s) with mismatched '
+            'bucket bounds (peers disagree on a histogram geometry)',
+            conflicts)
     return out
 
 
@@ -555,14 +857,41 @@ class TelemetryExporter:
             def log_message(self, fmt, *args):
                 get_logger('exporter').debug(fmt, *args)
 
-        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        # Bind with retry, then fall back to an ephemeral port: a stale
+        # TIME_WAIT socket or a colliding process on the configured
+        # telemetry_port must degrade the scrape target, not crash the
+        # learner. The actual bound port is logged (and kept on .port).
+        log = get_logger('exporter')
+        requested = self._port
+        attempts = ([requested] * 3 + [0]) if requested else [0]
+        server, last_err = None, None
+        for i, port in enumerate(attempts):
+            try:
+                server = ThreadingHTTPServer((self._host, port), Handler)
+                break
+            except OSError as exc:
+                last_err = exc
+                if port and i + 1 < len(attempts) and attempts[i + 1]:
+                    log.warning('telemetry port %d bind failed (%s); '
+                                'retrying', port, exc)
+                    time.sleep(0.2 * (i + 1))
+        if server is None:
+            log.error('telemetry exporter could not bind any port (%s); '
+                      'exporter disabled for this run', last_err)
+            return self
+        self._server = server
         self._server.daemon_threads = True
         self._port = self._server.server_address[1]
+        if requested and self._port != requested:
+            counter('telemetry_port_fallbacks_total').inc()
+            log.warning('telemetry_port %d unavailable (%s); serving '
+                        '/metrics on ephemeral port %d instead',
+                        requested, last_err, self._port)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
-        get_logger('exporter').info('telemetry exporter serving /metrics '
-                                    'on port %d', self._port)
+        log.info('telemetry exporter serving /metrics on port %d',
+                 self._port)
         return self
 
     def stop(self):
@@ -570,6 +899,55 @@ class TelemetryExporter:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+# ---------------------------------------------------------------------------
+# XLA compile-event counters (jax.monitoring listeners)
+
+_JAX_MONITORING_INSTALLED = False
+
+
+def install_jax_monitoring() -> bool:
+    """Subscribe to jax.monitoring and count XLA compile activity into the
+    registry: ``xla_compile_events_total{event=...}`` (cache hits/misses,
+    compile requests) and the ``xla_compile_seconds`` duration histogram
+    (jaxpr trace / MLIR lowering / backend compile). Idempotent and
+    version-tolerant — a jax without the monitoring API simply reports
+    False. Catches unexpected recompiles (a new padded bucket shape, a
+    donation-geometry change) that otherwise only show up as mystery
+    latency spikes in the trace."""
+    global _JAX_MONITORING_INSTALLED
+    if _JAX_MONITORING_INSTALLED:
+        return True
+    try:
+        import jax.monitoring as _jm
+    except Exception:
+        return False
+
+    def _on_event(event, *a, **kw):
+        try:
+            if 'compil' in event:
+                REGISTRY.counter('xla_compile_events_total',
+                                 event=str(event).strip('/')).inc()
+        except Exception:
+            pass   # a metrics listener must never break a compile
+
+    def _on_duration(event, duration, *a, **kw):
+        try:
+            if 'compil' in event:
+                REGISTRY.histogram('xla_compile_seconds',
+                                   buckets=COMPILE_SECOND_BUCKETS).observe(
+                                       float(duration))
+        except Exception:
+            pass
+
+    try:
+        _jm.register_event_listener(_on_event)
+        _jm.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _JAX_MONITORING_INSTALLED = True
+    return True
 
 
 # ---------------------------------------------------------------------------

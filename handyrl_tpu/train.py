@@ -61,11 +61,36 @@ from .worker import WorkerCluster, WorkerServer
 _LOG = telemetry.get_logger('train')
 
 
+class TracedBatch:
+    """A built batch plus the sampled episode trace ids of the windows in
+    it — the thread-batcher's counterpart of SharedBatch.trace_ids, wrapped
+    only while episode tracing is active so the hot path stays untouched
+    when it is off."""
+
+    __slots__ = ('batch', 'trace_ids')
+
+    def __init__(self, batch, trace_ids):
+        self.batch = batch
+        self.trace_ids = trace_ids
+
+
+def _selected_trace_ids(selected) -> List[str]:
+    """Deduplicated, deterministically-sampled trace ids of the episodes a
+    batch's windows were selected from (recency bias repeats episodes)."""
+    out = []
+    for sel in selected:
+        tid = telemetry.episode_trace_id(sel.get('args') or {})
+        if tid and telemetry.trace_sampled(tid):
+            out.append(tid)
+    return sorted(set(out))
+
+
 def _batcher_process(conn, bid: int):
     """Child-process batch builder (config: batcher_processes=True)."""
     from .connection import force_cpu_backend
     force_cpu_backend()
     from .ops.batch import make_block_cache
+    telemetry.set_process_label('batcher-%d' % bid)
     _LOG.info('started batcher process %d', bid)
     cache, have_cache = None, False
     while True:
@@ -98,6 +123,7 @@ def _batcher_process_shm(conn, bid: int):
     force_cpu_backend()
     from .ops.shm_batch import ArenaRing, batch_spec, copy_into
     from .utils.timing import StageTimer
+    telemetry.set_process_label('batcher-%d' % bid)
     _LOG.info('started shm batcher process %d', bid)
     from .ops.batch import make_block_cache
     ring = None
@@ -142,6 +168,10 @@ def _batcher_process_shm(conn, bid: int):
                            cache=cache)
             desc['slot'] = slot
             desc['timing'] = timer.snapshot(reset=True)
+            if telemetry.trace_enabled():
+                # sampled episode ids of this slot's windows: the trainer's
+                # train_step trace event links back through them
+                desc['trace'] = _selected_trace_ids(selected)
             conn.send(desc)
     finally:
         # this process OWNS the segments: unlink them on any exit (pipe
@@ -186,6 +216,31 @@ class Batcher:
         self._executor = None
         self._arena_map = None
         self._shm_layouts: Dict[int, tuple] = {}
+        # policy-lag accounting: window SELECTION is the consumption point,
+        # so lag-in-epochs (learner epoch - the model_id that generated the
+        # episode) and age-in-seconds (now - learner ingest stamp) are
+        # observed here, for every selection path (threads and processes).
+        # ``epoch_fn`` is installed by the Learner (it owns model_epoch).
+        self.epoch_fn = None
+        self._m_lag = telemetry.REGISTRY.histogram(
+            'policy_lag_epochs', buckets=telemetry.LAG_EPOCH_BUCKETS)
+        self._m_age = telemetry.REGISTRY.histogram(
+            'sample_age_seconds', buckets=telemetry.AGE_SECOND_BUCKETS)
+
+    def _observe_lag(self, selected):
+        fn = self.epoch_fn
+        if fn is None or not telemetry.enabled():
+            return
+        epoch, now = int(fn()), time.time()
+        for sel in selected:
+            args = sel.get('args') or {}
+            for mid in (args.get('model_id') or {}).values():
+                if mid is None or mid < 0:
+                    continue
+                self._m_lag.observe(max(0, epoch - int(mid)))
+            rt = sel.get('recv_time')
+            if rt is not None:
+                self._m_age.observe(max(0.0, now - float(rt)))
 
     def _selector(self):
         while True:
@@ -198,6 +253,7 @@ class Batcher:
                 continue
             if self.timer is not None:
                 self.timer.add('select', time.perf_counter() - t0)
+            self._observe_lag(selected)
             # strip non-picklable/irrelevant entries from the job payload
             job_args = {k: v for k, v in self.args.items()
                         if k in ('turn_based_training', 'observation',
@@ -243,7 +299,8 @@ class Batcher:
                 self.timer.add(stage, row['s'], int(row['n']))
         pool, slot = self._executor, desc['slot']
         return SharedBatch(views,
-                           lambda: pool.send_to(bid, ('__free__', slot)))
+                           lambda: pool.send_to(bid, ('__free__', slot)),
+                           trace_ids=desc.get('trace'))
 
     def _worker(self, bid: int):
         _LOG.info('started batcher %d', bid)
@@ -254,8 +311,11 @@ class Batcher:
                             for _ in range(self.args['batch_size'])]
                 if self.timer is not None:
                     self.timer.add('select', time.perf_counter() - t0)
+                self._observe_lag(selected)
                 batch = self.build_fn(selected, self.args, timer=self.timer,
                                       cache=self.cache)
+                if telemetry.trace_enabled():
+                    batch = TracedBatch(batch, _selected_trace_ids(selected))
             except (IndexError, ValueError):
                 time.sleep(0.1)
                 continue
@@ -377,6 +437,12 @@ class Trainer:
         self.update_flag = False
         self.update_queue: queue.Queue = queue.Queue(maxsize=1)
         self._loss_sum: Dict[str, float] = {}
+        # learning-dynamics accumulators: 'diag_'-prefixed device metrics
+        # (rho/c clip counts, importance-ratio moments, grad norm) folded
+        # out of the lazy metric fetch, summarized per epoch into
+        # ``last_dynamics`` (metrics_jsonl + gauges + the TIMING line)
+        self._diag_sum: Dict[str, float] = {}
+        self.last_dynamics: Dict[str, float] = {}
         self.shutdown_flag = False
         self.failed = False
         self.started = False
@@ -516,8 +582,14 @@ class Trainer:
                 return None
             timer.add('ipc', time.perf_counter() - t0)
             release = None
+            # episode tracing: both wrapper flavors (TracedBatch from the
+            # thread batcher, SharedBatch from the shm children) carry the
+            # sampled trace ids of the windows in the batch
+            tids = getattr(nxt, 'trace_ids', None)
             if hasattr(nxt, 'release'):      # shared-memory slot wrapper
                 nxt, release = nxt.batch, nxt.release
+            elif tids is not None:           # TracedBatch (thread batcher)
+                nxt = nxt.batch
             t0 = time.perf_counter()
             if self.mesh is not None:
                 dev = shard_batch(self.mesh, nxt)
@@ -530,7 +602,7 @@ class Trainer:
                 jax.block_until_ready(dev)
                 release()
             timer.add('h2d', time.perf_counter() - t0)
-            return dev
+            return dev, tids
 
         def top_up():
             while len(staged) < self.prefetch_depth:
@@ -605,16 +677,25 @@ class Trainer:
                 top_up()
                 if not staged:
                     continue
-            batch = staged.popleft()
+            batch, batch_tids = staged.popleft()
             lr_val = self._lr()
             if self.chaos_nan.due(self.steps):
                 _LOG.warning('chaos: injecting non-finite update at step %d',
                              self.steps)
                 lr_val = float('nan')
             lr = jnp.asarray(lr_val, jnp.float32)
+            t_wall = time.time()
             t_dispatch = time.perf_counter()
             self.state, metrics = self.update_step(self.state, batch, lr)
-            timer.add('compute', time.perf_counter() - t_dispatch)
+            dt_dispatch = time.perf_counter() - t_dispatch
+            timer.add('compute', dt_dispatch)
+            if batch_tids:
+                # the gradient end of the episode trace: one event per
+                # update, linking every sampled episode whose window this
+                # batch consumed (ids already passed deterministic sampling)
+                telemetry.trace_event('train_step', ts=t_wall,
+                                      dur=dt_dispatch, always=True,
+                                      trace_ids=batch_tids, steps=self.steps)
             # the ring refills (device_put of the next batches) while the
             # dispatched step runs on device
             top_up()
@@ -644,11 +725,16 @@ class Trainer:
             self.data_cnt_ema = (self.data_cnt_ema * 0.8
                                  + data_cnt / (1e-2 + batch_cnt) * 0.2)
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
+            self.last_dynamics = self._epoch_dynamics(loss_sum, data_cnt,
+                                                      batch_cnt)
             if os.environ.get('HANDYRL_TPU_TIMING') == '1':
                 # one line per epoch: seconds + event counts per ingest
-                # stage ('compute' is dispatch time; 'drain' is the sync)
-                print('ingest timing: %s' % json.dumps(
-                    self.ingest_timer.snapshot(reset=True)))
+                # stage ('compute' is dispatch time; 'drain' is the sync),
+                # plus the epoch's learning-dynamics summary
+                line = self.ingest_timer.snapshot(reset=True)
+                if self.last_dynamics:
+                    line['dynamics'] = self.last_dynamics
+                print('ingest timing: %s' % json.dumps(line))
         from .utils.fetch import fetch_tree
         return fetch_tree(self.state.params)
 
@@ -749,6 +835,10 @@ class Trainer:
                     data_cnt += int(v)
                 elif k == 'nonfinite':
                     bad += int(v)
+                elif k.startswith('diag_'):
+                    # learning-dynamics diagnostics: summarized per epoch
+                    # by _epoch_dynamics, never on the reference loss line
+                    self._diag_sum[k] = self._diag_sum.get(k, 0.0) + float(v)
                 else:
                     if k == 'total':
                         total_sum += float(v)
@@ -758,6 +848,34 @@ class Trainer:
         self._guard_observe(bad, n_updates - bad,
                             total_sum / data_cnt if data_cnt else None)
         return data_cnt
+
+    def _epoch_dynamics(self, loss_sum: Dict[str, float], data_cnt: int,
+                        n_updates: int) -> Dict[str, float]:
+        """Reduce the epoch's accumulated ``diag_*`` device metrics into
+        the learning-dynamics summary: V-Trace rho/c clip fractions,
+        importance-ratio mean/std, policy entropy per acting sample, and
+        mean global grad norm per update. Values are mirrored onto gauges
+        (live Prometheus exposition) and returned for metrics_jsonl + the
+        HANDYRL_TPU_TIMING line."""
+        d, self._diag_sum = self._diag_sum, {}
+        dc, nu = max(1, data_cnt), max(1, n_updates)
+        out: Dict[str, float] = {}
+        if 'ent' in loss_sum:
+            out['entropy'] = loss_sum['ent'] / dc
+        if 'diag_rho_clip' in d:
+            out['rho_clip_fraction'] = d['diag_rho_clip'] / dc
+            out['c_clip_fraction'] = d.get('diag_c_clip', 0.0) / dc
+        if 'diag_rho_sum' in d:
+            mean = d['diag_rho_sum'] / dc
+            out['importance_ratio_mean'] = mean
+            var = max(0.0, d.get('diag_rho_sq_sum', 0.0) / dc - mean * mean)
+            out['importance_ratio_std'] = var ** 0.5
+        if 'diag_grad_norm' in d:
+            out['grad_norm'] = d['diag_grad_norm'] / nu
+        out = {k: round(float(v), 6) for k, v in out.items()}
+        for k, v in out.items():
+            telemetry.gauge(k).set(v)
+        return out
 
     # -- non-finite guard --------------------------------------------------
     def _guard_observe(self, bad: int, good: int,
@@ -886,13 +1004,25 @@ class Learner:
 
         # -- unified telemetry: one run id for the whole fleet (workers
         # receive it in the merged config and stamp their own registries),
-        # a master collection switch, and the optional Prometheus endpoint
-        if not args.get('telemetry', True):
+        # a master collection switch, episode-lifecycle tracing, and the
+        # optional Prometheus endpoint. The telemetry knob accepts a bool
+        # (legacy switch) or a block with trace_dir / trace_sample_rate.
+        tel = telemetry.config_block(args)
+        if not tel['enabled']:
             telemetry.set_enabled(False)
         args.setdefault('run_id', telemetry.run_id())
         telemetry.set_run_id(args['run_id'])
+        telemetry.set_process_label('learner')
+        telemetry.configure_tracing(tel.get('trace_dir') or None,
+                                    tel.get('trace_sample_rate'))
+        if telemetry.enabled():
+            # XLA compile-event counters (cache hits, compile durations)
+            telemetry.install_jax_monitoring()
         self._last_fleet_telemetry: Optional[dict] = None
         self._exporter = None
+        # epoch means of the policy-lag/sample-age histograms are computed
+        # as deltas between epochs; marks hold the last-read (sum, count)
+        self._lag_marks: Dict[str, tuple] = {}
 
         self.env = make_env(env_args)
         eval_modify_rate = (args['update_episodes'] ** 0.85) / args['update_episodes']
@@ -981,6 +1111,21 @@ class Learner:
 
         self.trainer = Trainer(args, self.wrapper)
         self.trainer.rollback_source = self._rollback_source
+        # policy-lag accounting: the batcher stamps lag at window selection
+        # against the CURRENT learner epoch (consumption, not ingest)
+        self.trainer.batcher.epoch_fn = lambda: self.model_epoch
+        # profile_epochs: wrap chosen epochs in a jax.profiler device trace
+        # (start at the previous epoch's close, stop at the chosen epoch's
+        # close). Disables the legacy one-shot auto-trace — the knob says
+        # exactly which epochs the operator wants.
+        from .config import parse_epoch_set
+        self._profile_epochs = parse_epoch_set(args.get('profile_epochs'))
+        if self._profile_epochs:
+            if not self.trainer._profile_dir:
+                self.trainer._profile_dir = os.path.join(
+                    telemetry.trace_dir() or args.get('model_dir', 'models'),
+                    'profile')
+            self.trainer._profiled = True   # suppress the legacy auto-start
         if self._resume:
             state_path = self.trainer_state_path()
             if os.path.exists(state_path):
@@ -1296,6 +1441,11 @@ class Learner:
 
         live = [e for e in episodes if e is not None]
         telemetry.counter('learner_episodes_returned_total').inc(len(live))
+        # ingest stamp for the sample-age histogram: selection-time age is
+        # measured against this learner-side clock (no cross-host skew)
+        now = time.time()
+        for e in live:
+            e.setdefault('recv_time', now)
         self.trainer.episodes.extend(live)
         if self.trainer.ingest_queue is not None:
             # best-effort under backlog, but every drop is counted — the
@@ -1391,6 +1541,49 @@ class Learner:
         self._last_fleet_telemetry = merged
         return merged
 
+    def _lag_snapshot(self) -> Dict[str, float]:
+        """Epoch means of the policy-lag / sample-age histograms (delta
+        since the previous epoch), mirrored onto plainly-named gauges so
+        ``policy_lag`` and ``sample_age_seconds`` are scrapeable live."""
+        out: Dict[str, float] = {}
+        batcher = getattr(self.trainer, 'batcher', None)
+        if batcher is None:
+            return out
+        for attr, key in (('_m_lag', 'policy_lag'),
+                          ('_m_age', 'sample_age_seconds')):
+            hist = getattr(batcher, attr, None)
+            if hist is None:
+                continue
+            s, n = hist.sum, hist.count
+            prev_s, prev_n = self._lag_marks.get(key, (0.0, 0))
+            self._lag_marks[key] = (s, n)
+            if n > prev_n:
+                mean = (s - prev_s) / (n - prev_n)
+                out[key] = round(mean, 4)
+                telemetry.gauge(key + '_mean').set(mean)
+        return out
+
+    # -- device profiling (profile_epochs) --------------------------------
+    def _maybe_profile(self):
+        """Open/close the jax.profiler device trace around the epochs the
+        ``profile_epochs`` knob names: epoch N's SGD work runs between the
+        close of epoch N-1 and the close of epoch N, so the trace starts
+        at the boundary BEFORE a chosen epoch and stops at its close
+        (Trainer._start/_stop_trace are idempotent and exception-safe)."""
+        if not self._profile_epochs:
+            return
+        tr = self.trainer
+        if tr._trace_active:
+            tr._stop_trace()
+        if (self.model_epoch + 1) in self._profile_epochs:
+            _LOG.info('profiling epoch %d (device trace -> %s)',
+                      self.model_epoch + 1, tr._profile_dir)
+            try:
+                tr._start_trace()
+            except Exception as exc:
+                _LOG.warning('profiler start failed (%s: %s)',
+                             type(exc).__name__, str(exc)[:120])
+
     # -- epoch boundary ---------------------------------------------------
     def update(self):
         print()
@@ -1408,6 +1601,7 @@ class Learner:
             params = self.wrapper.params
         self.update_model(params, steps, state_blob)
         self._write_metrics(steps)
+        self._maybe_profile()
         self.flags = set()
 
     def _write_metrics(self, steps: int, extra: Optional[dict] = None):
@@ -1446,6 +1640,14 @@ class Learner:
                 self.trainer.ring_occupancy(), 4)
             rec['replay_sample_reuse'] = round(
                 stats['samples_drawn'] / max(1, stats['windows_ingested']), 3)
+        # learning dynamics (ops/train_step.py diag metrics, per epoch):
+        # rho/c clip fractions, importance-ratio moments, entropy, grad
+        # norm — the off-policy health the streaming-ingest and staleness-
+        # weighting work will be judged against (docs/observability.md)
+        rec.update(self.trainer.last_dynamics)
+        # policy-lag accounting: epoch means of the lag/age histograms the
+        # batcher observes at window selection (consumption time)
+        rec.update(self._lag_snapshot())
         # guard health: cumulative skipped non-finite updates, in-place
         # rollbacks, and dropped poisoned episodes (guard.py)
         rec['guard_nonfinite'] = self.trainer.guard.total_bad
@@ -1468,6 +1670,7 @@ class Learner:
         # append-safe single-write line + fsync: a killed learner can never
         # leave a torn half-line that breaks downstream JSONL parsing
         append_jsonl(self._metrics_path, rec)
+        telemetry.trace_flush()   # epoch boundary: land buffered spans
 
     def _run_eval_share(self, evaluator, tracker: Dict[str, int]):
         """Advance online evaluation until its share of episodes reaches
@@ -1883,12 +2086,15 @@ class Learner:
 
         data_cnt = 0
         loss_sum: Dict[str, float] = {}
+        diag_sum: Dict[str, float] = {}
         for metrics in pending_metrics:   # host floats — no device fetch
             for k, v in metrics.items():
                 if k == 'data_count':
                     data_cnt += int(v)
                 elif k == 'nonfinite':
                     continue   # guard counter, observed per chunk
+                elif k.startswith('diag_'):
+                    diag_sum[k] = diag_sum.get(k, 0.0) + float(v)
                 else:
                     loss_sum[k] = loss_sum.get(k, 0.0) + float(v)
         if epoch_steps > 0:
@@ -1898,6 +2104,9 @@ class Learner:
             tr.data_cnt_ema = (tr.data_cnt_ema * 0.8
                                + data_cnt / (1e-2 + epoch_steps) * 0.2)
             tr.last_steps_per_sec = epoch_steps / max(epoch_wall, 1e-9)
+            tr._diag_sum = diag_sum
+            tr.last_dynamics = tr._epoch_dynamics(loss_sum, data_cnt,
+                                                  epoch_steps)
         if tr.replay is not None:
             tr.replay_stats['samples_drawn'] += (
                 epoch_steps * self.args['batch_size'])
@@ -1928,6 +2137,7 @@ class Learner:
         rec_extra = {'dispatches_gen': fp.dispatches,
                      'dispatches_eval': getattr(evaluator, 'dispatches', 0)}
         self._write_metrics(tr.steps, rec_extra)
+        self._maybe_profile()
         self.flags = set()
 
     def _print_eval_stats(self):
@@ -2248,6 +2458,15 @@ class Learner:
         if self._exporter is not None:
             self._exporter.stop()
             self._exporter = None
+        # collate this run's trace JSONL into the Chrome/Perfetto JSON (a
+        # no-op with tracing off); the JSONL remains the source of truth
+        try:
+            out = telemetry.finalize_trace()
+            if out:
+                _LOG.info('episode trace collated to %s', out)
+        except Exception as exc:
+            _LOG.warning('trace finalize failed (%s: %s)',
+                         type(exc).__name__, str(exc)[:120])
         self.preempt.uninstall()
 
     def run(self):
@@ -2258,6 +2477,7 @@ class Learner:
         self._trainer_thread = threading.Thread(target=self.trainer.run,
                                                 daemon=True)
         self._trainer_thread.start()
+        self._maybe_profile()   # profile_epochs may name the first epoch
         try:
             if self.use_batched_generation:
                 self._run_batched()

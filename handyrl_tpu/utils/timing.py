@@ -22,6 +22,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict
 
+from .. import telemetry
+
 
 class StageTimer:
     """Thread-safe accumulator of per-stage wall time.
@@ -32,7 +34,10 @@ class StageTimer:
     ``registry`` (a telemetry.MetricRegistry) mirrors every ``add`` into
     the ``stage_seconds{stage=...}`` span-histogram family, so the same
     measurements that feed the per-epoch timing line and the ingest bench
-    also feed the fleet-wide telemetry/exporter view.
+    also feed the fleet-wide telemetry/exporter view — and, when episode
+    tracing is active (``HANDYRL_TPU_TRACE``), each registry-mirrored add
+    also lands as a rate-sampled batch-level span in the trace file (one
+    vocabulary for bench rows, timing lines, histograms and traces).
     """
 
     def __init__(self, registry=None):
@@ -47,6 +52,7 @@ class StageTimer:
             self._n[stage] = self._n.get(stage, 0) + count
         if self._registry is not None:
             self._registry.observe_stage(stage, seconds, count)
+            telemetry.trace_stage(stage, seconds, count)
 
     @contextmanager
     def section(self, stage: str):

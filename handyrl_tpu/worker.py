@@ -72,7 +72,8 @@ class Worker:
 
     def __init__(self, args: Dict[str, Any], conn, wid: int):
         _LOG.info('opened worker %d', wid)
-        telemetry.set_run_id(args.get('run_id'))
+        telemetry.adopt_config(args)
+        telemetry.set_process_label('worker-%d' % wid)
         self.worker_id = wid
         self.conn = conn
         self.env = make_env({**args['env'], 'id': wid})
@@ -121,6 +122,9 @@ class Worker:
         self.conn.send((HEARTBEAT_KIND,
                         {'worker': self.worker_id,
                          'telemetry': telemetry.snapshot()}))
+        # keep the shared trace file current even while this worker lives:
+        # a gather-killed (chaos) worker must not strand its episode spans
+        telemetry.trace_flush()
 
     def _rpc(self, msg):
         """One blocking call-response on the gather pipe. In engine mode
@@ -196,6 +200,41 @@ def _shard(total: int, parts: int, index: int) -> int:
     return total // parts + (1 if index < total % parts else 0)
 
 
+class UploadTrace:
+    """Per-episode ``upload`` spans for the gather relay: payload stash
+    time -> server ack. Only deterministically-sampled trace ids are
+    tracked (the same keep/drop every other hop computes), bounded so a
+    long outage cannot grow the book past the resend buffer's order."""
+
+    MAX_PER_KIND = 512
+
+    def __init__(self, gather_id: int):
+        self.gather_id = int(gather_id)
+        self._box: Dict[str, list] = defaultdict(list)
+
+    def stash(self, kind: str, payload):
+        if not telemetry.trace_enabled():
+            return
+        tid = telemetry.episode_trace_id((payload or {}).get('args') or {})
+        if tid and telemetry.trace_sampled(tid):
+            box = self._box[kind]
+            if len(box) < self.MAX_PER_KIND:
+                box.append((tid, time.time()))
+
+    def shipped(self, kind: str):
+        """The server acked this kind's batch: emit one span per tracked
+        payload covering its whole stash->ack residence in the relay."""
+        entries = self._box.pop(kind, None)
+        if not entries:
+            return
+        now = time.time()
+        for tid, t0 in entries:
+            telemetry.trace_event('upload', ts=t0, dur=now - t0,
+                                  trace_id=tid, kind=kind,
+                                  gather=self.gather_id)
+        telemetry.trace_flush()
+
+
 class Gather:
     """Fan-in relay between ~16 workers and the learner.
 
@@ -218,8 +257,10 @@ class Gather:
     def __init__(self, args: Dict[str, Any], server_conn, gather_id: int,
                  reconnect=None):
         _LOG.info('started gather %d', gather_id)
-        telemetry.set_run_id(args.get('run_id'))
+        telemetry.adopt_config(args)
+        telemetry.set_process_label('gather-%d' % gather_id)
         self.gather_id = gather_id
+        self._upload_trace = UploadTrace(gather_id)
         gid = str(gather_id)
         self._m_uploads = {
             'episode': telemetry.counter('gather_uploads_total',
@@ -330,6 +371,7 @@ class Gather:
                             'telemetry': snap}))
             except Exception:
                 pass   # the RPC path owns failure handling and reconnect
+            telemetry.trace_flush()   # keep the shared trace file current
 
     def _recover(self, exc: Exception):
         """Redial the data port with exponential backoff + jitter (the
@@ -401,6 +443,7 @@ class Gather:
 
     def _stash_upload(self, kind: str, payload):
         self._upload_box[kind].append(payload)
+        self._upload_trace.stash(kind, payload)
         self._upload_count += 1
         if kind in self._m_uploads:
             self._m_uploads[kind].inc()
@@ -417,6 +460,7 @@ class Gather:
                 self._server_rpc((kind, self._upload_box[kind]))
                 # acked: this kind's batch is safely booked server-side
                 del self._upload_box[kind]
+                self._upload_trace.shipped(kind)
             self._upload_count = sum(len(v) for v in self._upload_box.values())
         self._m_box_depth.set(self._upload_count)
 
@@ -449,6 +493,7 @@ class Gather:
         for kind in list(self._upload_box):
             if self._upload_box[kind]:
                 self._server_rpc((kind, self._upload_box[kind]))
+                self._upload_trace.shipped(kind)
             del self._upload_box[kind]
         if self.engine is not None:
             self.engine.stop()
@@ -604,7 +649,7 @@ class RemoteWorkerCluster:
 
     def run(self):
         merged = entry(self.args)
-        telemetry.set_run_id(merged.get('run_id'))
+        telemetry.adopt_config(merged)
         _LOG.info('joined run %s as %s (base_worker_id %s, %s gathers)',
                   merged.get('run_id', '?'), self.args['address'],
                   merged['worker'].get('base_worker_id'),

@@ -1,0 +1,222 @@
+"""Collate episode-lifecycle trace files into a critical-path summary.
+
+Input: a trace directory (``HANDYRL_TPU_TRACE``) holding the per-run
+``trace-<run_id>.jsonl`` event stream (and/or the finalized
+``trace-<run_id>.json`` Chrome-trace file), or a single file of either
+flavor. Every event is a Chrome-trace "complete" event; episode-linked
+events carry ``args.trace_id`` (derived from the server-stamped task) and
+the learner's ``train_step`` events carry ``args.trace_ids`` — the sampled
+episodes whose windows that update consumed.
+
+Output: a per-stage latency table, the per-episode critical path
+(task_assign -> generate -> upload -> ingest -> train_step) with
+generation->gradient p50/p95, and the batch-level stage summaries
+(select/decode/assemble/ipc/h2d/compute/engine_batch). ``--chrome OUT``
+additionally writes one merged Chrome-trace JSON across every run found.
+
+Exit code: 0 when at least one complete generation->gradient chain was
+found, 2 otherwise (the CI smoke asserts 0). Stdlib only.
+
+Usage:
+    python scripts/trace_report.py <dir-or-file> [--chrome OUT] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+# the episode-lifecycle stage chain, in causal order (one vocabulary with
+# docs/observability.md "Tracing"); 'evaluate' chains are reported too but
+# only generation chains feed the generation->gradient headline
+CHAIN_STAGES = ('task_assign', 'generate', 'upload', 'ingest', 'train_step')
+
+# batch-level stages worth a duration summary when present
+BATCH_STAGES = ('select', 'decode', 'assemble', 'ipc', 'h2d', 'compute',
+                'drain', 'engine_batch', 'generate', 'upload', 'evaluate')
+
+
+def discover_files(path: str) -> List[str]:
+    """Trace files under ``path``: per run, prefer the append-forever JSONL
+    (a superset of the finalized snapshot) and fall back to the .json."""
+    if os.path.isfile(path):
+        return [path]
+    jsonls = sorted(glob.glob(os.path.join(path, 'trace-*.jsonl')))
+    have = {os.path.splitext(os.path.basename(p))[0] for p in jsonls}
+    jsons = [p for p in sorted(glob.glob(os.path.join(path, 'trace-*.json')))
+             if os.path.splitext(os.path.basename(p))[0] not in have]
+    return jsonls + jsons
+
+
+def load_events(files: List[str]) -> List[Dict[str, Any]]:
+    events: List[Dict[str, Any]] = []
+    for path in files:
+        try:
+            with open(path) as f:
+                if path.endswith('.json'):
+                    events.extend(json.load(f).get('traceEvents', []))
+                    continue
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue   # torn tail line from a killed process
+        except (OSError, ValueError) as exc:
+            print('warning: skipping unreadable %s (%s)' % (path, exc),
+                  file=sys.stderr)
+    return events
+
+
+def build_chains(events: List[Dict[str, Any]]
+                 ) -> Dict[str, Dict[str, Tuple[int, int, int]]]:
+    """trace_id -> {stage: (ts, dur, pid)}; the earliest event wins per
+    stage (re-issues/resends may repeat a stage — the first occurrence is
+    the critical-path one, later ones are retries)."""
+    chains: Dict[str, Dict[str, Tuple[int, int, int]]] = defaultdict(dict)
+
+    def note(tid, stage, ev):
+        cur = chains[tid].get(stage)
+        ent = (int(ev.get('ts', 0)), int(ev.get('dur', 0)),
+               int(ev.get('pid', 0)))
+        if cur is None or ent[0] < cur[0]:
+            chains[tid][stage] = ent
+
+    for ev in events:
+        if ev.get('ph') != 'X':
+            continue
+        args = ev.get('args') or {}
+        name = ev.get('name')
+        tid = args.get('trace_id')
+        if tid and name in CHAIN_STAGES:
+            note(tid, name, ev)
+        for linked in (args.get('trace_ids') or ()):
+            if name == 'train_step':
+                note(linked, 'train_step', ev)
+    return chains
+
+
+def chain_errors(stages: Dict[str, Tuple[int, int, int]]) -> List[str]:
+    """Causal-order violations within one chain: each present stage must
+    START no earlier than the previous present stage's start (spans may
+    overlap across hosts by clock skew; a start-order inversion beyond
+    that indicates broken propagation)."""
+    errors = []
+    prev: Optional[Tuple[str, int]] = None
+    for stage in CHAIN_STAGES:
+        ent = stages.get(stage)
+        if ent is None:
+            continue
+        if prev is not None and ent[0] < prev[1]:
+            errors.append('%s starts before %s' % (stage, prev[0]))
+        prev = (stage, ent[0])
+    return errors
+
+
+def percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))
+    return vals[idx]
+
+
+def summarize(events: List[Dict[str, Any]], as_json: bool = False) -> int:
+    chains = build_chains(events)
+    pids = {ev.get('pid') for ev in events if ev.get('ph') == 'X'}
+
+    # batch-level stage durations
+    stage_durs: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        if ev.get('ph') == 'X' and ev.get('name') in BATCH_STAGES:
+            stage_durs[ev['name']].append(int(ev.get('dur', 0)) / 1e6)
+
+    # per-chain segments + generation->gradient totals
+    seg_durs: Dict[str, List[float]] = defaultdict(list)
+    totals: List[float] = []
+    complete = 0
+    bad_chains = 0
+    for tid, stages in chains.items():
+        if chain_errors(stages):
+            bad_chains += 1
+        present = [(s,) + stages[s] for s in CHAIN_STAGES if s in stages]
+        for (s_a, ts_a, dur_a, _p), (s_b, ts_b, _d, _q) in zip(
+                present, present[1:]):
+            seg_durs['%s->%s' % (s_a, s_b)].append(
+                max(0.0, (ts_b - ts_a) / 1e6))
+        if 'generate' in stages and 'train_step' in stages:
+            complete += 1
+            t_end = stages['train_step'][0] + stages['train_step'][1]
+            totals.append(max(0.0, (t_end - stages['generate'][0]) / 1e6))
+
+    report = {
+        'events': len(events),
+        'processes': len(pids),
+        'chains': len(chains),
+        'complete_chains': complete,
+        'order_violations': bad_chains,
+        'stage_seconds': {
+            name: {'n': len(d), 'p50': round(percentile(d, 0.50), 6),
+                   'p95': round(percentile(d, 0.95), 6)}
+            for name, d in sorted(stage_durs.items())},
+        'segment_seconds': {
+            name: {'n': len(d), 'p50': round(percentile(d, 0.50), 6),
+                   'p95': round(percentile(d, 0.95), 6)}
+            for name, d in sorted(seg_durs.items())},
+        'generation_to_gradient_seconds': {
+            'n': len(totals), 'p50': round(percentile(totals, 0.50), 6),
+            'p95': round(percentile(totals, 0.95), 6)},
+    }
+    if as_json:
+        print(json.dumps(report))
+    else:
+        print('trace report: %d events from %d processes, %d episode '
+              'chains (%d complete, %d order violations)'
+              % (report['events'], report['processes'], report['chains'],
+                 complete, bad_chains))
+        print('stage durations (s):')
+        for name, row in report['stage_seconds'].items():
+            print('  %-14s p50=%-10g p95=%-10g n=%d'
+                  % (name, row['p50'], row['p95'], row['n']))
+        print('critical path (%s):' % ' -> '.join(CHAIN_STAGES))
+        for name, row in report['segment_seconds'].items():
+            print('  %-26s p50=%-10g p95=%-10g n=%d'
+                  % (name, row['p50'], row['p95'], row['n']))
+        g2g = report['generation_to_gradient_seconds']
+        print('generation->gradient: p50=%g p95=%g n=%d'
+              % (g2g['p50'], g2g['p95'], g2g['n']))
+    return 0 if complete > 0 else 2
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('path', help='trace dir (HANDYRL_TPU_TRACE) or one '
+                                     'trace-*.jsonl / trace-*.json file')
+    parser.add_argument('--chrome', metavar='OUT',
+                        help='also write one merged Chrome-trace JSON')
+    parser.add_argument('--json', action='store_true',
+                        help='machine-readable summary (one JSON object)')
+    opts = parser.parse_args(argv)
+
+    files = discover_files(opts.path)
+    if not files:
+        print('no trace files under %r' % opts.path, file=sys.stderr)
+        return 2
+    events = load_events(files)
+    if opts.chrome:
+        with open(opts.chrome, 'w') as f:
+            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        print('merged Chrome trace -> %s (%d events)'
+              % (opts.chrome, len(events)), file=sys.stderr)
+    return summarize(events, as_json=opts.json)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

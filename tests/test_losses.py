@@ -138,6 +138,41 @@ def test_rnn_burn_in_matches_T_slicing():
     assert np.isfinite(float(total))
 
 
+def test_compute_loss_off_policy_diagnostics():
+    """The learning-dynamics aux: rho/c clip counts and importance-ratio
+    moments, summed over acting pairs so data_count normalizes them."""
+    batch = _ttt_batch()
+    module = SimpleConv2dModel()
+    params = _params(module, batch)
+    _total, aux = compute_loss(module.apply, params, None, batch,
+                               LossConfig())
+    diag = aux['diag']
+    dcnt = float(aux['data_count'])
+    for key in ('rho_clip', 'c_clip', 'rho_sum', 'rho_sq_sum'):
+        assert np.isfinite(float(diag[key])), key
+    # clip fractions are counts of acting pairs: within [0, data_count]
+    assert 0.0 <= float(diag['rho_clip']) <= dcnt
+    assert 0.0 <= float(diag['c_clip']) <= dcnt
+    # the ratio's second moment dominates its first (Jensen)
+    assert float(diag['rho_sq_sum']) >= 0.0
+    assert float(diag['rho_sum']) > 0.0
+
+
+def test_update_step_emits_diag_metrics():
+    """diag_* metrics (incl. the global grad norm) ride the compiled step's
+    metric dict — and stay off the loss-line keys (no 'diag_' prefix there
+    would leak into the printed reference format)."""
+    batch = _ttt_batch(B=4)
+    module = SimpleConv2dModel()
+    state = init_train_state(_params(module, batch))
+    step = build_update_step(module, LossConfig(), donate=False)
+    _state2, metrics = step(state, batch, jnp.asarray(1e-3, jnp.float32))
+    for key in ('diag_grad_norm', 'diag_rho_clip', 'diag_rho_sum'):
+        assert key in metrics, sorted(metrics)
+        assert np.isfinite(float(metrics[key])), key
+    assert float(metrics['diag_grad_norm']) > 0
+
+
 def test_update_step_single_device():
     batch = _ttt_batch(B=4)
     module = SimpleConv2dModel()

@@ -165,6 +165,35 @@ def test_merge_skips_mismatched_bucket_bounds():
     assert merged['hists']['lat']['count'] == 1   # peer with other bounds skipped
 
 
+def test_merge_counts_mismatched_bucket_bounds():
+    """The disagree path must drop-with-counter, never mis-add: the first
+    peer's histogram survives untouched, every later disagreeing peer is
+    counted — as a merged COUNTER (so the signal survives re-merging up
+    the fleet tree and reaches the exposition) and as a top-level field."""
+    a = _snap(hists={'lat': {'bounds': [0.1, 1.0], 'buckets': [1, 0, 0],
+                             'sum': 0.05, 'count': 1}})
+    b = _snap(hists={'lat': {'bounds': [0.2, 1.0], 'buckets': [3, 0, 0],
+                             'sum': 0.3, 'count': 3}})
+    c = _snap(hists={'lat': {'bounds': [0.1], 'buckets': [5, 0],
+                             'sum': 0.5, 'count': 5}})
+    merged = merge_snapshots([a, b, c])
+    # first peer wins the geometry; neither disagreeing peer was mis-added
+    assert merged['hists']['lat']['bounds'] == [0.1, 1.0]
+    assert merged['hists']['lat']['buckets'] == [1, 0, 0]
+    assert merged['hists']['lat']['count'] == 1
+    assert abs(merged['hists']['lat']['sum'] - 0.05) < 1e-12
+    assert merged['hist_bound_conflicts'] == 2
+    assert merged['counters']['telemetry_hist_bound_conflicts_total'] == 2
+    # the conflict counter itself re-merges like any flow
+    again = merge_snapshots([merged, merged])
+    assert again['counters']['telemetry_hist_bound_conflicts_total'] == 4
+    # agreeing peers still add and report no conflict
+    clean = merge_snapshots([a, a])
+    assert clean['hists']['lat']['count'] == 2
+    assert 'hist_bound_conflicts' not in clean
+    assert 'telemetry_hist_bound_conflicts_total' not in clean['counters']
+
+
 def test_summarize_reduces_histograms():
     h = {'bounds': [0.1, 1.0], 'buckets': [8, 1, 1], 'sum': 2.0, 'count': 10}
     out = summarize(_snap({'c_total': 1}, {'g': 2.0}, {'lat': h}))
@@ -244,6 +273,32 @@ def test_render_prometheus_format():
     assert 'lat_seconds_bucket{le="0.1"} 1' in body
     assert 'lat_seconds_bucket{le="+Inf"} 1' in body
     assert 'lat_seconds_count 1' in body
+
+
+def test_exporter_falls_back_to_ephemeral_port():
+    """A busy telemetry_port must not crash the learner: the exporter
+    retries, falls back to an ephemeral port, logs the real one (kept on
+    .port) and counts the fallback."""
+    reg = MetricRegistry()
+    reg.counter('pings_total').inc(1)
+    blocker = TelemetryExporter(lambda: [reg.snapshot()], port=0).start()
+    try:
+        busy_port = blocker.port
+        before = telemetry.counter('telemetry_port_fallbacks_total').value
+        exporter = TelemetryExporter(lambda: [reg.snapshot()],
+                                     port=busy_port).start()
+        try:
+            assert exporter.port != busy_port and exporter.port > 0
+            assert telemetry.counter(
+                'telemetry_port_fallbacks_total').value == before + 1
+            body = urllib.request.urlopen(
+                'http://127.0.0.1:%d/metrics' % exporter.port,
+                timeout=10).read().decode()
+            assert 'pings_total 1' in body
+        finally:
+            exporter.stop()
+    finally:
+        blocker.stop()
 
 
 def test_exporter_serves_metrics_over_http():
