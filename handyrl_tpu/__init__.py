@@ -9,6 +9,17 @@ orchestration.
 
 __version__ = "0.1.0"
 
+# Runtime concurrency sanitizer (analysis/sanitizer.py): opt-in via
+# HANDYRL_TPU_SANITIZE=1 — the chaos/e2e CI legs run under it. It must
+# install BEFORE any framework lock or thread exists, which is exactly
+# import time; unset (the default) this is a single env check and the
+# package import stays side-effect free.
+import os as _os
+if _os.environ.get('HANDYRL_TPU_SANITIZE', '').strip().lower() \
+        not in ('', '0', 'false', 'off'):
+    from .analysis import sanitizer as _sanitizer
+    _sanitizer.install_from_env()
+
 _cache_ready = False
 
 

@@ -1,0 +1,110 @@
+"""graftlint — invariant-enforcing static analysis for handyrl_tpu.
+
+``python -m handyrl_tpu.analysis --strict`` is the CI gate. Rules:
+
+* **GL001** determinism — unseeded RNG / wall clock in record-producing
+  paths (the PR 5 ``(seed, sample_key, params)`` byte-identity contract).
+* **GL002** host-sync — device fetches / traced-value coercions inside
+  jit/shard_map-compiled functions (the PR 4 no-extra-syncs contract).
+* **GL003** atomic-write — raw write-mode ``open()`` anywhere in the
+  package; durable files go through ``utils/fs.py`` (PRs 2/4).
+* **GL004** lock discipline — ``# guarded-by:`` fields touched outside
+  their lock, anonymous/unaccounted threads (Hub/Gather/engine tier).
+* **GL005** vocabulary — metrics/stages/config knobs drifting out of sync
+  with docs/observability.md, docs/parameters.md and config.validate.
+
+Suppression: ``# graftlint: allow[GLnnn] <reason>`` pragmas inline, or
+``.graftlint-baseline.json`` entries (reason mandatory) for grandfathered
+findings. ``analysis.sanitizer`` is the runtime half: a lock-order
+-inversion detector + thread accountant the chaos legs enable with
+``HANDYRL_TPU_SANITIZE=1``. See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional
+
+from .checkers import (check_gl001, check_gl002, check_gl003, check_gl004,
+                       dedupe, in_scope, SCOPE_GL001, SCOPE_GL004)
+from .core import (BASELINE_NAME, Finding, LintResult, RULES, SourceFile,
+                   apply_suppressions, load_baseline, load_source,
+                   write_baseline)
+from .vocabulary import check_gl005
+
+__all__ = ['RULES', 'Finding', 'LintResult', 'SourceFile', 'run_lint',
+           'collect_sources', 'BASELINE_NAME']
+
+DEFAULT_RULES = tuple(sorted(RULES))
+
+# files GL005 needs beyond the package sources
+_EXTRA_PATHS = ('docs/observability.md', 'docs/parameters.md')
+
+
+def repo_root() -> str:
+    """The tree to lint: parent of the installed package directory."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def collect_sources(root: str,
+                    paths: Optional[Iterable[str]] = None
+                    ) -> Dict[str, SourceFile]:
+    """Load the lint surface: every .py under handyrl_tpu/ plus the docs
+    GL005 reads (or an explicit path list, repo-relative)."""
+    rels: List[str] = []
+    if paths:
+        rels = [p.replace(os.sep, '/') for p in paths]
+    else:
+        pkg_dir = os.path.join(root, 'handyrl_tpu')
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != '__pycache__']
+            for fn in sorted(filenames):
+                if fn.endswith('.py'):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    rels.append(rel.replace(os.sep, '/'))
+        rels.extend(_EXTRA_PATHS)
+    sources: Dict[str, SourceFile] = {}
+    for rel in rels:
+        src = load_source(root, rel)
+        if src is not None:
+            sources[rel] = src
+    return sources
+
+
+def run_checks(sources: Dict[str, SourceFile],
+               rules: Iterable[str] = DEFAULT_RULES) -> List[Finding]:
+    rules = set(rules)
+    findings: List[Finding] = []
+    for path, src in sorted(sources.items()):
+        if not path.endswith('.py'):
+            continue
+        if 'GL001' in rules and in_scope(path, SCOPE_GL001):
+            findings.extend(check_gl001(src))
+        if 'GL003' in rules and path.startswith('handyrl_tpu/'):
+            findings.extend(check_gl003(src))
+        if 'GL004' in rules and in_scope(path, SCOPE_GL004):
+            findings.extend(check_gl004(src))
+    if 'GL002' in rules:
+        findings.extend(check_gl002(sources))
+    if 'GL005' in rules:
+        findings.extend(check_gl005(sources))
+    findings = dedupe(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_lint(root: Optional[str] = None,
+             rules: Iterable[str] = DEFAULT_RULES,
+             baseline_path: Optional[str] = None,
+             paths: Optional[Iterable[str]] = None) -> LintResult:
+    """The full pipeline: collect -> check -> pragma/baseline filter."""
+    root = root or repo_root()
+    sources = collect_sources(root, paths)
+    findings = run_checks(sources, rules)
+    bl_path = baseline_path or os.path.join(root, BASELINE_NAME)
+    baseline, errors = load_baseline(bl_path)
+    baseline = [e for e in baseline if e.rule in set(rules)]
+    result = apply_suppressions(findings, sources, baseline)
+    result.config_errors.extend(errors)
+    return result

@@ -1,0 +1,95 @@
+"""graftlint CLI: ``python -m handyrl_tpu.analysis [--strict] [paths...]``.
+
+Exit codes: 0 clean (everything pragma'd/baselined with reasons), 1 live
+findings (plus, under ``--strict``, reasonless pragmas, stale baseline
+entries, and baseline config errors), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (BASELINE_NAME, DEFAULT_RULES, RULES, repo_root, run_lint)
+from .core import write_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='python -m handyrl_tpu.analysis',
+        description='graftlint: invariant-enforcing static analysis '
+                    '(rules GL001-GL005; see docs/static_analysis.md)')
+    ap.add_argument('paths', nargs='*',
+                    help='repo-relative files to lint (default: the whole '
+                         'package + docs)')
+    ap.add_argument('--root', default=None,
+                    help='repo root (default: autodetected from the package)')
+    ap.add_argument('--rules', default=','.join(DEFAULT_RULES),
+                    help='comma-separated rule ids to run')
+    ap.add_argument('--baseline', default=None,
+                    help='baseline file (default: <root>/%s)' % BASELINE_NAME)
+    ap.add_argument('--strict', action='store_true',
+                    help='also fail on stale baseline entries, reasonless '
+                         'pragmas and baseline config errors (the CI gate)')
+    ap.add_argument('--write-baseline', action='store_true',
+                    help='write current live findings to the baseline file '
+                         '(reasons must then be filled in by hand)')
+    ap.add_argument('--list-rules', action='store_true')
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print('%s  %s' % (rid, RULES[rid]))
+        return 0
+
+    rules = [r.strip().upper() for r in args.rules.split(',') if r.strip()]
+    unknown = [r for r in rules if r not in RULES]
+    if unknown:
+        print('graftlint: unknown rule(s): %s' % ', '.join(unknown),
+              file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    result = run_lint(root=root, rules=rules, baseline_path=args.baseline,
+                      paths=args.paths or None)
+
+    for f in result.findings:
+        print(f.render())
+    for f in result.pragma_errors:
+        print(f.render())
+
+    strict_failures = 0
+    if args.strict:
+        for entry in result.stale_baseline:
+            strict_failures += 1
+            print('%s: %s STALE baseline entry (context %r matches '
+                  'nothing) — delete it' % (entry.path, entry.rule,
+                                            entry.context[:60]))
+        for err in result.config_errors:
+            strict_failures += 1
+            print('graftlint: %s' % err)
+    elif result.config_errors:
+        for err in result.config_errors:
+            print('graftlint: warning: %s' % err, file=sys.stderr)
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(root, BASELINE_NAME)
+        write_baseline(path, result.findings)
+        print('graftlint: wrote %d baseline entr%s to %s — fill in the '
+              'reasons' % (len(result.findings),
+                           'y' if len(result.findings) == 1 else 'ies',
+                           path))
+
+    print('graftlint: %d finding(s), %d baselined, %d pragma-suppressed'
+          % (len(result.findings) + len(result.pragma_errors),
+             len(result.baselined), len(result.suppressed))
+          + (', %d strict failure(s)' % strict_failures
+             if args.strict and strict_failures else ''))
+    if result.findings or result.pragma_errors or strict_failures:
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""graftlint core: findings, pragmas, and the grandfather baseline.
+
+The framework invariants PRs 1-7 earned (seeded sampling, no host syncs in
+compiled code, atomic durable writes, lock discipline, one documented
+metric/knob vocabulary) hold only as long as every later edit preserves
+them. This package turns each invariant into a checker with a stable rule
+id; this module holds the pieces every checker shares:
+
+* :class:`Finding` — one (rule, path, line, message) violation. Findings
+  carry the stripped source line as ``context``: baseline matching keys on
+  (rule, path, context) instead of line numbers, so unrelated edits above a
+  grandfathered site do not churn the baseline.
+
+* **Pragmas** — ``# graftlint: allow[GL001] <reason>`` on the flagged line
+  (or the line above) suppresses that rule there. The reason is mandatory:
+  a bare pragma does not suppress, it is reported as its own violation —
+  an undocumented exemption is exactly the rot the suite exists to stop.
+
+* **Baseline** — ``.graftlint-baseline.json`` at the repo root lists
+  grandfathered findings, each with a written reason. An entry suppresses
+  every finding matching its (rule, path, context); an entry matching
+  nothing is STALE (the code it excused is gone) and fails ``--strict`` so
+  the baseline only ever shrinks deliberately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+RULES: Dict[str, str] = {
+    'GL001': 'determinism: unseeded RNG / wall clock in record-producing paths',
+    'GL002': 'host-sync: device fetch or traced-value coercion in compiled code',
+    'GL003': 'atomic-write: raw write-mode open() must route through utils/fs.py',
+    'GL004': 'lock discipline: guarded-by fields and thread accounting',
+    'GL005': 'vocabulary drift: metrics/stages/knobs out of sync with docs',
+}
+
+_PRAGMA_RE = re.compile(
+    r'#\s*graftlint:\s*allow\[(GL\d{3})\]\s*(.*)$')
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-root-relative, posix separators
+    line: int
+    message: str
+    context: str = ''  # stripped source line (the baseline key)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+    def render(self) -> str:
+        return '%s:%d: %s %s' % (self.path, self.line, self.rule,
+                                 self.message)
+
+
+@dataclass
+class SourceFile:
+    """One parsed source file plus its pragma table."""
+
+    path: str                      # repo-relative posix path
+    text: str
+    lines: List[str] = field(default_factory=list)
+    # line number -> {rule: reason-or-None}; reasonless pragmas keep None
+    pragmas: Dict[int, Dict[str, Optional[str]]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.lines = self.text.splitlines()
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                reason = m.group(2).strip() or None
+                self.pragmas.setdefault(i, {})[m.group(1)] = reason
+
+    def context(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ''
+
+    def finding(self, rule: str, line: int, message: str) -> Finding:
+        return Finding(rule, self.path, line, message, self.context(line))
+
+    def pragma_for(self, rule: str, line: int) -> Optional[Tuple[int, Optional[str]]]:
+        """(pragma line, reason) covering ``rule`` at ``line`` — the flagged
+        line itself or the line directly above."""
+        for cand in (line, line - 1):
+            rules = self.pragmas.get(cand)
+            if rules and rule in rules:
+                return cand, rules[rule]
+        return None
+
+
+def load_source(root: str, relpath: str) -> Optional[SourceFile]:
+    try:
+        with open(os.path.join(root, relpath), encoding='utf-8') as f:
+            return SourceFile(relpath, f.read())
+    except (OSError, UnicodeDecodeError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+BASELINE_NAME = '.graftlint-baseline.json'
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    context: str
+    reason: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+
+def load_baseline(path: str) -> Tuple[List[BaselineEntry], List[str]]:
+    """(entries, errors). Entries without a reason are config errors, not
+    silent suppressions."""
+    if not os.path.exists(path):
+        return [], []
+    try:
+        with open(path, encoding='utf-8') as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [], ['baseline %s unreadable: %s' % (path, exc)]
+    entries, errors = [], []
+    for i, item in enumerate(raw if isinstance(raw, list) else []):
+        rule = str(item.get('rule', ''))
+        reason = str(item.get('reason', '') or '').strip()
+        if rule not in RULES:
+            errors.append('baseline entry %d: unknown rule %r' % (i, rule))
+            continue
+        if not reason:
+            errors.append('baseline entry %d (%s %s): missing reason — '
+                          'every grandfathered finding must say why'
+                          % (i, rule, item.get('path')))
+            continue
+        entries.append(BaselineEntry(rule, str(item.get('path', '')),
+                                     str(item.get('context', '')).strip(),
+                                     reason))
+    if not isinstance(raw, list):
+        errors.append('baseline %s: expected a JSON list' % path)
+    return entries, errors
+
+
+def write_baseline(path: str, findings: List[Finding]):
+    entries = [{'rule': f.rule, 'path': f.path, 'context': f.context,
+                'reason': 'TODO: justify this exemption'}
+               for f in findings]
+    # one entry per key: several findings on identical lines (e.g. the
+    # reference builder's draw repeated in the arena twin) share one excuse
+    seen, out = set(), []
+    for e in entries:
+        k = (e['rule'], e['path'], e['context'])
+        if k not in seen:
+            seen.add(k)
+            out.append(e)
+    # graftlint: allow[GL003] the baseline is dev-tool output rewritten on demand, not a durable run artifact
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(out, f, indent=2)
+        f.write('\n')
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)      # live
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)    # by pragma
+    pragma_errors: List[Finding] = field(default_factory=list)
+    stale_baseline: List[BaselineEntry] = field(default_factory=list)
+    config_errors: List[str] = field(default_factory=list)
+
+
+def apply_suppressions(findings: List[Finding], sources: Dict[str, SourceFile],
+                       baseline: List[BaselineEntry]) -> LintResult:
+    """Split raw findings into live / baselined / pragma-suppressed, flag
+    reasonless pragmas, and detect stale baseline entries."""
+    result = LintResult()
+    used_keys = set()
+    baseline_keys = {e.key() for e in baseline}
+    for f in findings:
+        src = sources.get(f.path)
+        pragma = src.pragma_for(f.rule, f.line) if src else None
+        if pragma is not None:
+            pline, reason = pragma
+            if reason:
+                result.suppressed.append(f)
+            else:
+                result.pragma_errors.append(Finding(
+                    f.rule, f.path, pline,
+                    'pragma without a reason does not suppress: '
+                    'write "# graftlint: allow[%s] <why>"' % f.rule,
+                    src.context(pline)))
+                result.findings.append(f)
+            continue
+        if f.key() in baseline_keys:
+            used_keys.add(f.key())
+            result.baselined.append(f)
+            continue
+        result.findings.append(f)
+    result.stale_baseline = [e for e in baseline if e.key() not in used_keys]
+    return result

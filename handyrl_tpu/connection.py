@@ -356,21 +356,25 @@ class Hub:
 
     def __init__(self, endpoints: Optional[List] = None, inbox_max: int = 256):
         self._inbox: queue.Queue = queue.Queue(maxsize=inbox_max)
-        self._outboxes: Dict[Any, queue.Queue] = {}
-        self._commands: deque = deque()
+        # every mutable map below is shared by the read loop, the per-
+        # endpoint writers and arbitrary caller threads; one lock guards
+        # them all (lexical discipline checked by graftlint GL004)
         self._lock = threading.Lock()
-        self._liveness: Dict[Any, float] = {}
-        self._last_recv: Dict[Any, float] = {}
-        self._peer_info: Dict[Any, Any] = {}
-        self._detach_events: deque = deque(maxlen=4096)
-        self.stats: Dict[str, int] = {}
+        self._outboxes: Dict[Any, queue.Queue] = {}        # guarded-by: _lock
+        self._commands: deque = deque()                    # guarded-by: _lock
+        self._liveness: Dict[Any, float] = {}              # guarded-by: _lock
+        self._last_recv: Dict[Any, float] = {}             # guarded-by: _lock
+        self._peer_info: Dict[Any, Any] = {}               # guarded-by: _lock
+        self._detach_events: deque = deque(maxlen=4096)    # guarded-by: _lock
+        self.stats: Dict[str, int] = {}                    # guarded-by: _lock
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._selector = selectors.DefaultSelector()
         self._selector.register(self._wake_r, selectors.EVENT_READ, None)
         for ep in endpoints or []:
             self.attach(ep)
-        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._read_loop, name='hub-read',
+                         daemon=True).start()
 
     # -- public api (any thread) --
 
@@ -435,7 +439,7 @@ class Hub:
             self.stats['attached'] = self.stats.get('attached', 0) + 1
             telemetry.gauge('hub_peers').set(len(self._outboxes))
         threading.Thread(target=self._write_loop, args=(endpoint, outbox),
-                         daemon=True).start()
+                         name='hub-write', daemon=True).start()
         self._wake()
 
     # API name kept for operator familiarity with the reference logs
@@ -625,7 +629,8 @@ class JobPool:
         return self.results
 
     def start(self):
-        threading.Thread(target=self._dispatch, daemon=True).start()
+        threading.Thread(target=self._dispatch, name='jobpool-dispatch',
+                         daemon=True).start()
 
     def recv(self):
         return self.results.get()

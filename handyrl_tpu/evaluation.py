@@ -26,6 +26,8 @@ import random
 import time
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from . import telemetry
 from .agent import Agent, EnsembleAgent, RandomAgent, RuleBasedAgent, SoftAgent
 from .connection import (accept_socket_connections, connect_socket_connection,
@@ -251,10 +253,28 @@ class Evaluator:
             self._opponent_cache[spec] = Agent(load_model(spec, self.env))
         return self._opponent_cache[spec]
 
+    def _draw_opponent(self, opponents, eval_args) -> str:
+        """Pool draw keyed by the server-stamped ``sample_key`` through the
+        audited seeded helper (graftlint GL001): which opponent an eval
+        task meets is then a pure function of (seed, sample_key), identical
+        across workers and ledger re-issues. Namespace 2 keeps the stream
+        disjoint from generation's episode keys (0) and worker-local
+        fallbacks (1)."""
+        if not opponents:
+            return self.default_opponent
+        skey = (eval_args or {}).get('sample_key')
+        if skey is None:
+            # direct use without a server task (tests, ad-hoc eval): any
+            # member of the pool is a valid opponent
+            return opponents[random.randrange(len(opponents))]  # graftlint: allow[GL001] no sample_key outside server-stamped tasks; opponent identity is recorded in the result payload either way
+        from .generation import sample_seed
+        seq = sample_seed(self.args.get('seed', 0), (2, int(skey)), 0)
+        idx = int(np.random.default_rng(seq).integers(len(opponents)))
+        return opponents[idx]
+
     def execute(self, models: Dict[int, Any], eval_args) -> Optional[dict]:
         opponents = self.args.get('eval', {}).get('opponent', [])
-        opponent = random.choice(opponents) if opponents \
-            else self.default_opponent
+        opponent = self._draw_opponent(opponents, eval_args)
 
         agents = {p: Agent(model) if model is not None
                   else self._opponent_agent(opponent)

@@ -494,9 +494,12 @@ class InferenceEngine:
         self._reply = reply_fn
         self._example_obs = example_obs
         self.vault: Optional[ModelVault] = None   # built lazily (engine thread)
-        self._queue: deque = deque()              # (endpoint, request, t_arrival)
         self._cv = threading.Condition()
-        self._stop = False
+        # intake queue entries are (endpoint, request, t_arrival); shared by
+        # submitters (hub loop), the engine thread, and the supervisor's
+        # drain (lexical discipline checked by graftlint GL004)
+        self._queue: deque = deque()              # guarded-by: _cv
+        self._stop = False                        # guarded-by: _cv
         self._thread: Optional[threading.Thread] = None
         # watchdog surface: last tick progress + the tick's in-flight items
         self.started_at = time.monotonic()
@@ -523,13 +526,15 @@ class InferenceEngine:
 
     def start(self) -> 'InferenceEngine':
         self.started_at = self.last_progress = time.monotonic()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run,
+                                        name='inference-engine', daemon=True)
         self._thread.start()
         return self
 
     def stop(self, timeout: float = 10.0):
         with self._cv:
             self._stop = True
+            queued = len(self._queue)
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
@@ -541,7 +546,7 @@ class InferenceEngine:
                 _LOG.warning(
                     'engine: loop thread still running %.0fs after stop() '
                     '(last progress %.1fs ago, %d queued) — leaking it',
-                    timeout, self.progress_age(), len(self._queue))
+                    timeout, self.progress_age(), queued)
 
     def abandon(self):
         """Mark the engine stopped without joining (supervisor restart of a
@@ -562,7 +567,9 @@ class InferenceEngine:
 
     def busy(self) -> bool:
         """True when the engine holds work a stalled thread would strand."""
-        return bool(self._queue) or bool(self._current)
+        with self._cv:
+            queued = bool(self._queue)
+        return queued or bool(self._current)
 
     def batch_fill_ratio(self) -> float:
         """Mean requests per dispatched forward batch (1.0 = no coalescing
@@ -866,7 +873,8 @@ class EngineSupervisor:
         self._m_stale = telemetry.counter('engine_stale_replies_total')
         self._spawned_at = time.monotonic()
         self.engine: Optional[InferenceEngine] = self._spawn()
-        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread = threading.Thread(target=self._watch,
+                                        name='engine-supervisor', daemon=True)
         self._thread.start()
 
     # -- bench/back-compat surface ----------------------------------------

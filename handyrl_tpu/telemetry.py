@@ -139,8 +139,11 @@ class _TraceState:
             self.rate = 1.0
         self.label = 'proc'
         self.lock = threading.Lock()
-        self.buf: List[str] = []
-        self.meta_done = False
+        # event buffer + its one-shot metadata flag share the sink lock
+        # (lexical discipline checked by graftlint GL004; *_locked helpers
+        # are called with it held)
+        self.buf: List[str] = []          # guarded-by: lock
+        self.meta_done = False            # guarded-by: lock
 
 
 _TRACE = _TraceState()
@@ -173,8 +176,9 @@ def configure_tracing(trace_dir: Optional[str] = None,
     if trace_dir is not None and (force or
                                   not os.environ.get('HANDYRL_TPU_TRACE')):
         trace_flush()
-        _TRACE.dir = str(trace_dir).strip()
-        _TRACE.meta_done = False
+        with _TRACE.lock:   # a racing trace_event must not emit its meta
+            _TRACE.dir = str(trace_dir).strip()   # line into the old sink
+            _TRACE.meta_done = False
         os.environ['HANDYRL_TPU_TRACE'] = _TRACE.dir
 
 
@@ -345,11 +349,13 @@ def finalize_trace() -> Optional[str]:
     if not events:
         return None
     out = os.path.join(_TRACE.dir, 'trace-%s.json' % _RUN_ID)
-    tmp = out + '.tmp'
     try:
-        with open(tmp, 'w') as f:
-            json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
-        os.replace(tmp, out)
+        # atomic publish through the shared fs helper (GL003): a collate
+        # interrupted mid-write must not leave a half-JSON next to the
+        # intact JSONL source of truth
+        from .utils.fs import atomic_write_bytes
+        atomic_write_bytes(out, json.dumps(
+            {'traceEvents': events, 'displayTimeUnit': 'ms'}).encode('utf-8'))
     except OSError:
         return None
     return out
@@ -581,12 +587,13 @@ class MetricRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._hists: Dict[str, Histogram] = {}
+        self._counters: Dict[str, Counter] = {}    # guarded-by: _lock
+        self._gauges: Dict[str, Gauge] = {}        # guarded-by: _lock
+        self._hists: Dict[str, Histogram] = {}     # guarded-by: _lock
 
     def counter(self, name: str, **labels) -> Counter:
         key = metric_key(name, labels)
+        # graftlint: allow[GL004] lock-free fast path; the dict only grows and setdefault under the lock makes the miss race benign
         c = self._counters.get(key)
         if c is None:
             with self._lock:
@@ -595,6 +602,7 @@ class MetricRegistry:
 
     def gauge(self, name: str, **labels) -> Gauge:
         key = metric_key(name, labels)
+        # graftlint: allow[GL004] lock-free fast path; the dict only grows and setdefault under the lock makes the miss race benign
         g = self._gauges.get(key)
         if g is None:
             with self._lock:
@@ -604,6 +612,7 @@ class MetricRegistry:
     def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
                   **labels) -> Histogram:
         key = metric_key(name, labels)
+        # graftlint: allow[GL004] lock-free fast path; the dict only grows and setdefault under the lock makes the miss race benign
         h = self._hists.get(key)
         if h is None:
             with self._lock:
@@ -888,6 +897,7 @@ class TelemetryExporter:
                         '/metrics on ephemeral port %d instead',
                         requested, last_err, self._port)
         self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name='telemetry-exporter',
                                         daemon=True)
         self._thread.start()
         log.info('telemetry exporter serving /metrics on port %d',

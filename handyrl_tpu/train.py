@@ -281,7 +281,8 @@ class Batcher:
             self._executor.start()
             return
         for i in range(self.args['num_batchers']):
-            t = threading.Thread(target=self._worker, args=(i,), daemon=True)
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 name='batcher-%d' % i, daemon=True)
             t.start()
             self._threads.append(t)
 
@@ -2475,7 +2476,7 @@ class Learner:
         self.preempt.install()
         guard_mod.arm_chaos_preempt(self._chaos)
         self._trainer_thread = threading.Thread(target=self.trainer.run,
-                                                daemon=True)
+                                                name='trainer', daemon=True)
         self._trainer_thread.start()
         self._maybe_profile()   # profile_epochs may name the first epoch
         try:

@@ -294,6 +294,7 @@ class Gather:
             server_conn.sock.settimeout(self._rpc_timeout)
             if self._hb_interval > 0:
                 threading.Thread(target=self._heartbeat_loop,
+                                 name='gather-%d-heartbeat' % gather_id,
                                  daemon=True).start()
 
         n_total = args['worker']['num_parallel']
@@ -310,7 +311,8 @@ class Gather:
         self.block = 1 + n_here // 4          # round-trip amortization factor
         self.SNAP_SLOTS = 4                   # snapshots cached per relay
         self._task_stock: deque = deque()
-        self._snap_cache: OrderedDict = OrderedDict()
+        # shared with the engine thread's snapshot fetches (graftlint GL004)
+        self._snap_cache: OrderedDict = OrderedDict()   # guarded-by: _rpc_lock
         self._upload_box: Dict[str, list] = defaultdict(list)
         self._upload_count = 0
         # the engine thread fetches snapshots through the same server link
@@ -600,7 +602,8 @@ class WorkerServer(WorkerCluster):
 
     def run(self):
         for loop in (self._entry_loop, self._data_loop):
-            threading.Thread(target=loop, daemon=True).start()
+            threading.Thread(target=loop, name=loop.__name__.strip('_'),
+                             daemon=True).start()
 
 
 def entry(worker_args, retries: int = 30, delay: float = 2.0):

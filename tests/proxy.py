@@ -53,7 +53,8 @@ class ChaosProxy:
         self.delay = 0.0
         self.accepted = 0
         self._closed = False
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._accept_loop, name='proxy-accept',
+                 daemon=True).start()
 
     def _accept_loop(self):
         while not self._closed:
@@ -75,7 +76,7 @@ class ChaosProxy:
             for src, dst in ((client, upstream), (upstream, client)):
                 threading.Thread(target=self._pump,
                                  args=(src, dst, src is upstream),
-                                 daemon=True).start()
+                                 name='proxy-pump', daemon=True).start()
 
     def _pump(self, src, dst, from_target: bool):
         while True:

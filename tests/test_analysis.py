@@ -1,0 +1,536 @@
+"""graftlint: every checker must catch a seeded violation AND pass its
+clean twin; the sanitizer must detect a deliberate ABBA inversion without
+hanging; and the CI gate itself must be green on the real tree.
+
+Fixture trees are laid out under tmp_path with the same repo-relative
+shapes the scope tables key on (``handyrl_tpu/generation.py`` etc.), so
+the fixtures exercise exactly the production scoping logic.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from handyrl_tpu.analysis import (collect_sources, repo_root, run_checks,
+                                  run_lint)
+from handyrl_tpu.analysis import sanitizer as sz
+from handyrl_tpu.analysis.core import (SourceFile, apply_suppressions,
+                                       load_baseline)
+from handyrl_tpu.analysis.checkers import (check_gl001, check_gl002,
+                                           check_gl003, check_gl004)
+from handyrl_tpu.analysis.vocabulary import check_gl005
+
+
+def _src(path, text):
+    return SourceFile(path, text)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# GL001 determinism
+
+
+GL001_DIRTY = '''
+import random
+import time
+import numpy as np
+
+def pick(xs):
+    t = time.time()
+    if random.random() < 0.5:
+        return random.choice(xs), t
+    return xs[np.random.randint(len(xs))], t
+'''
+
+GL001_CLEAN = '''
+import random
+import numpy as np
+import time
+
+def pick(xs, seed_seq):
+    rng = np.random.default_rng(seed_seq)
+    local = random.Random(7)
+    t = time.perf_counter()
+    return xs[int(rng.integers(len(xs)))], local.random(), t
+'''
+
+
+def test_gl001_flags_unseeded_draws_and_wall_clock():
+    findings = check_gl001(_src('handyrl_tpu/generation.py', GL001_DIRTY))
+    msgs = ' | '.join(f.message for f in findings)
+    assert len(findings) == 4
+    assert 'random.random' in msgs and 'random.choice' in msgs
+    assert 'np.random.randint' in msgs and 'time.time' in msgs
+
+
+def test_gl001_clean_twin_passes():
+    assert check_gl001(_src('handyrl_tpu/generation.py', GL001_CLEAN)) == []
+
+
+def test_gl001_out_of_scope_file_ignored():
+    assert run_checks(
+        {'handyrl_tpu/utils/timing.py':
+         _src('handyrl_tpu/utils/timing.py', GL001_DIRTY)},
+        rules=('GL001',)) == []
+
+
+# ---------------------------------------------------------------------------
+# GL002 host syncs in compiled code
+
+
+GL002_DIRTY = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def helper(x):
+    return float(x) + x.item()
+
+@jax.jit
+def step(x):
+    y = helper(x)
+    if jnp.any(y > 0):
+        y = np.asarray(y)
+    return y
+'''
+
+GL002_BUILDER_DIRTY = '''
+import jax
+import jax.numpy as jnp
+
+def build():
+    def update(x):
+        return int(x) + 1
+    return update
+
+fn = jax.jit(build())
+'''
+
+GL002_CLEAN = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def host_drain(dev):
+    # not traced: plain host code may materialize and coerce freely
+    vals = np.asarray(dev)
+    return float(vals[0]), int(vals[1])
+
+@jax.jit
+def step(x):
+    y = jnp.where(jnp.any(x > 0), x, -x)
+    return y.astype(jnp.float32)
+'''
+
+
+def test_gl002_flags_syncs_transitively_and_in_builders():
+    path = 'handyrl_tpu/ops/train_step.py'
+    findings = check_gl002({path: _src(path, GL002_DIRTY)})
+    msgs = ' | '.join(f.message for f in findings)
+    assert '.item()' in msgs                      # helper called from jit
+    assert 'float() coercion' in msgs
+    assert 'np.asarray' in msgs
+    assert 'branching on a traced value' in msgs
+
+    findings = check_gl002({path: _src(path, GL002_BUILDER_DIRTY)})
+    assert any('int() coercion' in f.message for f in findings)
+
+
+def test_gl002_clean_twin_passes_and_host_code_is_free():
+    path = 'handyrl_tpu/ops/train_step.py'
+    assert check_gl002({path: _src(path, GL002_CLEAN)}) == []
+
+
+def test_gl002_real_tree_is_clean():
+    sources = collect_sources(repo_root())
+    assert check_gl002(sources) == []
+
+
+# ---------------------------------------------------------------------------
+# GL003 raw write-mode open
+
+
+GL003_DIRTY = '''
+def save(path, data):
+    with open(path, 'wb') as f:
+        f.write(data)
+
+def log(path, line):
+    open(path, mode='a').write(line)
+'''
+
+GL003_CLEAN = '''
+from .utils.fs import atomic_write_bytes, append_jsonl
+
+def save(path, data):
+    atomic_write_bytes(path, data)
+
+def load(path):
+    with open(path, 'rb') as f:
+        return f.read()
+
+def peek(path):
+    return open(path).read()
+'''
+
+
+def test_gl003_flags_write_modes_only():
+    findings = check_gl003(_src('handyrl_tpu/train.py', GL003_DIRTY))
+    assert len(findings) == 2
+    assert check_gl003(_src('handyrl_tpu/train.py', GL003_CLEAN)) == []
+
+
+def test_gl003_fs_module_is_the_sanctioned_site():
+    assert check_gl003(_src('handyrl_tpu/utils/fs.py', GL003_DIRTY)) == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 lock discipline
+
+
+GL004_DIRTY = '''
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._peers = {}     # guarded-by: _lock
+
+    def count(self):
+        return len(self._peers)
+
+    def run(self):
+        threading.Thread(target=self.count, daemon=True).start()
+'''
+
+GL004_CLEAN = '''
+import threading
+
+class Hub:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._peers = {}     # guarded-by: _lock
+
+    def count(self):
+        with self._lock:
+            return len(self._peers)
+
+    def _sweep_locked(self):
+        self._peers.clear()
+
+    def run(self):
+        threading.Thread(target=self.count, name='hub-count',
+                         daemon=True).start()
+'''
+
+
+def test_gl004_flags_unlocked_access_and_anonymous_thread():
+    findings = check_gl004(_src('handyrl_tpu/connection.py', GL004_DIRTY))
+    assert any('guarded-by _lock' in f.message for f in findings)
+    assert any('without name=' in f.message for f in findings)
+
+
+def test_gl004_clean_twin_with_locked_helper_passes():
+    assert check_gl004(_src('handyrl_tpu/connection.py', GL004_CLEAN)) == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 vocabulary drift (mini doc/config/source tree)
+
+
+_OBS_DOC = '''# Observability
+## Metric catalog
+| name | type |
+|---|---|
+| `documented_total` | counter |
+| `ghost_total` | counter |
+## Span stage glossary
+| stage | meaning |
+|---|---|
+| `select` | selection |
+'''
+
+_PARAM_DOC = '''# Parameters
+## `train_args`
+| key | default | meaning |
+|---|---|---|
+| `gamma` | 0.8 | discount |
+| `phantom_knob` | 1 | no longer exists |
+'''
+
+_CONFIG = '''
+TRAIN_DEFAULTS = {
+    'gamma': 0.8,
+    'undocumented_knob': 3,
+}
+
+def validate(args):
+    ta = args['train_args']
+    assert float(ta.get('gamma')) > 0
+    assert ta.get('typo_knob') is None
+'''
+
+_EMITTER = '''
+from . import telemetry
+
+C = telemetry.counter('documented_total')
+U = telemetry.counter('undocumented_total')
+
+def f():
+    with telemetry.trace_span('undocumented_stage'):
+        pass
+'''
+
+
+def _gl005_tree(emitter=_EMITTER, config=_CONFIG, obs=_OBS_DOC,
+                params=_PARAM_DOC):
+    return {
+        'docs/observability.md': _src('docs/observability.md', obs),
+        'docs/parameters.md': _src('docs/parameters.md', params),
+        'handyrl_tpu/config.py': _src('handyrl_tpu/config.py', config),
+        'handyrl_tpu/train.py': _src('handyrl_tpu/train.py', emitter),
+    }
+
+
+def test_gl005_catches_every_drift_direction():
+    msgs = [f.message for f in check_gl005(_gl005_tree())]
+    blob = ' | '.join(msgs)
+    assert "'undocumented_total'" in blob          # code -> missing doc row
+    assert "'undocumented_stage'" in blob          # stage -> missing glossary
+    assert "'ghost_total'" in blob                 # doc -> emitted nowhere
+    assert "'undocumented_knob'" in blob           # default -> missing row
+    assert "'phantom_knob'" in blob                # doc row -> no default
+    assert "'typo_knob'" in blob                   # validate() -> unknown key
+
+
+def test_gl005_clean_tree_passes():
+    clean_emitter = '''
+from . import telemetry
+C = telemetry.counter('documented_total')
+G = telemetry.counter('ghost_total')
+
+def f():
+    with telemetry.trace_span('select'):
+        pass
+'''
+    clean_config = '''
+TRAIN_DEFAULTS = {
+    'gamma': 0.8,
+}
+
+def validate(args):
+    ta = args['train_args']
+    assert float(ta.get('gamma')) > 0
+'''
+    clean_params = _PARAM_DOC.replace(
+        "| `phantom_knob` | 1 | no longer exists |\n", '')
+    assert check_gl005(_gl005_tree(clean_emitter, clean_config,
+                                   _OBS_DOC, clean_params)) == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas + baseline
+
+
+def test_pragma_with_reason_suppresses_without_reason_fails():
+    dirty = '''
+import random
+
+def a(xs):
+    return random.choice(xs)  # graftlint: allow[GL001] draw is cosmetic
+
+def b(xs):
+    return random.choice(xs)  # graftlint: allow[GL001]
+'''
+    src = _src('handyrl_tpu/generation.py', dirty)
+    findings = check_gl001(src)
+    result = apply_suppressions(findings, {src.path: src}, [])
+    assert len(result.suppressed) == 1
+    assert len(result.findings) == 1           # reasonless pragma: still live
+    assert len(result.pragma_errors) == 1
+
+
+def test_baseline_matches_by_context_and_goes_stale(tmp_path):
+    dirty = 'import random\n\ndef f(xs):\n    return random.choice(xs)\n'
+    src = _src('handyrl_tpu/generation.py', dirty)
+    findings = check_gl001(src)
+    assert findings
+
+    bl = tmp_path / 'baseline.json'
+    bl.write_text(json.dumps([
+        {'rule': 'GL001', 'path': 'handyrl_tpu/generation.py',
+         'context': 'return random.choice(xs)', 'reason': 'grandfathered'},
+        {'rule': 'GL001', 'path': 'handyrl_tpu/generation.py',
+         'context': 'return random.shuffle(xs)', 'reason': 'gone'},
+    ]))
+    entries, errors = load_baseline(str(bl))
+    assert errors == []
+    result = apply_suppressions(findings, {src.path: src}, entries)
+    assert result.findings == []
+    assert len(result.baselined) == 1
+    assert [e.context for e in result.stale_baseline] == \
+        ['return random.shuffle(xs)']
+
+
+def test_baseline_entry_without_reason_is_a_config_error(tmp_path):
+    bl = tmp_path / 'baseline.json'
+    bl.write_text(json.dumps([
+        {'rule': 'GL001', 'path': 'x.py', 'context': 'y', 'reason': ''}]))
+    entries, errors = load_baseline(str(bl))
+    assert entries == []
+    assert errors and 'missing reason' in errors[0]
+
+
+# ---------------------------------------------------------------------------
+# the CI gate on the real tree
+
+
+def test_repo_is_strict_clean():
+    result = run_lint()
+    live = [f.render() for f in result.findings + result.pragma_errors]
+    assert live == [], '\n'.join(live)
+    assert [e.context for e in result.stale_baseline] == []
+    assert result.config_errors == []
+    # every grandfathered entry carries a non-trivial written reason
+    entries, _ = load_baseline(
+        os.path.join(repo_root(), '.graftlint-baseline.json'))
+    assert entries, 'expected grandfathered GL001 entries'
+    for e in entries:
+        assert len(e.reason) > 20, e
+
+
+def test_cli_strict_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, '-m', 'handyrl_tpu.analysis', '--strict'],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert '0 finding(s)' in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+
+
+@pytest.fixture
+def sanitized():
+    sz.install()
+    sz.reset()
+    try:
+        yield sz
+    finally:
+        sz.uninstall()
+        sz.reset()
+
+
+@pytest.mark.timeout(60)
+def test_sanitizer_detects_abba_without_hanging(sanitized):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    # sequential opposite-order acquisitions: the ABBA pattern is recorded
+    # from the edge graph alone — no actual deadlock is ever risked
+    for fn, name in ((ab, 'order-ab'), (ba, 'order-ba')):
+        t = threading.Thread(target=fn, name=name)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    report = sanitized.lock_report()
+    assert len(report['inversions']) == 1
+    with pytest.raises(AssertionError, match='lock-order inversion'):
+        sanitized.assert_clean()
+
+
+@pytest.mark.timeout(60)
+def test_sanitizer_consistent_order_is_clean(sanitized):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert sanitized.lock_report()['inversions'] == []
+    sanitized.assert_clean()
+
+
+@pytest.mark.timeout(60)
+def test_sanitizer_condition_wait_makes_no_phantom_edges(sanitized):
+    cv = threading.Condition()
+    other = threading.Lock()
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=10)
+            woke.append(1)
+
+    t = threading.Thread(target=waiter, name='cv-waiter')
+    t.start()
+    time.sleep(0.2)
+    with other:            # acquired while the waiter SLEEPS inside cv.wait
+        pass               # — must not read as nested under the cv lock
+    with cv:
+        cv.notify_all()
+    t.join(timeout=30)
+    assert woke == [1]
+    assert sanitized.lock_report()['inversions'] == []
+
+
+@pytest.mark.timeout(60)
+def test_sanitizer_rlock_reentrancy_adds_no_self_edges(sanitized):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert sanitized.lock_report()['edges'] == 0
+
+
+@pytest.mark.timeout(60)
+def test_thread_accountant_flags_unnamed_and_leaks(sanitized):
+    gate = threading.Event()
+    t = threading.Thread(target=gate.wait)      # anonymous, non-daemon
+    t.start()
+    report = sanitized.thread_report()
+    assert len(report['unnamed']) == 1
+    assert len(report['leaked']) == 1
+    with pytest.raises(AssertionError, match='leaked non-daemon thread'):
+        sanitized.assert_clean()
+    gate.set()
+    t.join(timeout=30)
+    assert sanitized.thread_report()['leaked'] == []
+
+
+@pytest.mark.timeout(120)
+def test_sanitizer_env_install_reports_at_exit(tmp_path):
+    """HANDYRL_TPU_SANITIZE=1 installs at package import and prints the
+    one-line report at exit — the wiring the chaos CI legs rely on."""
+    code = ('import threading\n'
+            'import handyrl_tpu\n'
+            'a = threading.Lock()\n'
+            'b = threading.Lock()\n'
+            'with a:\n'
+            '    with b: pass\n'
+            'with b:\n'
+            '    with a: pass\n')
+    env = dict(os.environ, HANDYRL_TPU_SANITIZE='1', JAX_PLATFORMS='cpu')
+    proc = subprocess.run([sys.executable, '-c', code], env=env,
+                          capture_output=True, text=True, timeout=90)
+    assert proc.returncode == 0, proc.stderr
+    assert 'graftlint-sanitizer: 1 lock-order inversion(s)' in proc.stderr

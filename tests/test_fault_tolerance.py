@@ -334,7 +334,10 @@ def test_chaos_remote_cluster_survives_faults(tmp_path):
     assert 'reconnecting' in worker_out
     # the learner noticed the dead peer and re-issued its booked tasks
     assert 'disconnected' in learner_out
-    ledger = json.loads(learner_out.split('LEDGER', 1)[1].strip())
+    # only the LEDGER line itself: trailing diagnostics (e.g. the
+    # graftlint-sanitizer exit report) may follow it in the stream
+    ledger = json.loads(
+        learner_out.split('LEDGER', 1)[1].strip().splitlines()[0])
     assert ledger['reissued'] >= 1, 'stranded tasks were never re-issued'
     assert ledger['completed'] <= ledger['assigned']
 
