@@ -57,6 +57,20 @@ ACTOR_METRIC = ('fleet episodes/sec (HungryGeese/GeeseNet, gather+workers '
                 'per-worker B=1)')
 ACTOR_UNIT = 'episodes/sec'
 
+# BENCH_MODE=mesh measures the mesh-sharded learner: SGD steps/sec of the
+# partition-rule-built NamedSharding/jit update step at 1/2/4/8 devices
+# (one subprocess per mesh size — the virtual-device count is fixed before
+# jax import). Each row carries BOTH the wall-clock rate of the sharded
+# program on this host's (possibly virtual) mesh AND the per-shard
+# strong-scaling projection: the single-device rate at batch B/ndev, i.e.
+# what each device of a real ndev-mesh computes per step. On a
+# one-core CI host the virtual mesh time-slices its devices, so the
+# projection (plus the measured cross-mesh loss parity) carries the
+# scaling claim; on real silicon the wall clock does.
+MESH_METRIC = ('sharded learner SGD steps/sec (GeeseNet B=128 T=16, '
+               'partition-rule NamedSharding jit over the data mesh)')
+MESH_UNIT = 'steps/sec'
+
 # Per-chip peaks by device_kind substring: (key, bf16 FLOP/s, HBM bytes/s).
 # Public figures: v4 275T & 1.23TB/s, v5e 197T & 819GB/s, v5p 459T &
 # 2.77TB/s, v6e 918T & 1.64TB/s.
@@ -90,7 +104,8 @@ def emit(value=0.0, vs_baseline=0.0, **extra):
         return
     _EMITTED = True
     metric, unit = {'ingest': (INGEST_METRIC, INGEST_UNIT),
-                    'actor': (ACTOR_METRIC, ACTOR_UNIT)}.get(
+                    'actor': (ACTOR_METRIC, ACTOR_UNIT),
+                    'mesh': (MESH_METRIC, MESH_UNIT)}.get(
                         _active_mode(), (METRIC, UNIT))
     line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
             'vs_baseline': round(float(vs_baseline), 2)}
@@ -610,6 +625,154 @@ def run_actor(probe: dict):
                    else 'dryrun'))
 
 
+def _mesh_child():
+    """BENCH_MODE=mesh subprocess: measure ONE mesh size.
+
+    The virtual-device count (XLA_FLAGS) must be fixed before jax imports,
+    hence a process per row. Prints exactly one JSON dict on stdout:
+    wall steps/sec of the sharded program, the per-shard strong-scaling
+    projection (single-device rate at batch B/ndev), the first-step loss
+    from fixed seeds (cross-mesh parity), and the per-device staged batch
+    bytes counted by ``mesh_shard_bytes_total``.
+    """
+    import jax
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
+    handyrl_tpu.setup_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+    from handyrl_tpu import telemetry
+    from handyrl_tpu.ops.train_step import build_update_step
+    from handyrl_tpu.parallel import partition
+    from handyrl_tpu.parallel.mesh import make_mesh, shard_batch
+
+    ndev = int(os.environ['BENCH_MESH_CHILD'])
+    B = int(os.environ.get('BENCH_MESH_BATCH', '128'))
+    T = int(os.environ.get('BENCH_MESH_T', '16'))
+    steps = int(os.environ.get('BENCH_MESH_STEPS', '5'))
+    devices = jax.devices()
+    if len(devices) < ndev:
+        print(json.dumps({'ndev': ndev,
+                          'error': 'only %d device(s)' % len(devices)}))
+        return
+    lr = jnp.asarray(1e-5, jnp.float32)
+    module, cfg, batch, state = headline_setup(B, T, seed=0)
+    row = {'ndev': ndev, 'batch': B, 'forward_steps': T,
+           'timed_steps': steps}
+
+    shard_bytes = telemetry.REGISTRY.counter('mesh_shard_bytes_total')
+    mark = shard_bytes.value
+    if ndev > 1:
+        mesh = make_mesh(devices[:ndev])
+        state_sh = partition.tree_shardings(mesh, state,
+                                            partition.DEFAULT_RULES)
+        step = build_update_step(module, cfg, mesh=mesh, donate=False,
+                                 state_shardings=state_sh)
+        batch = shard_batch(mesh, batch)   # per-shard host->device staging
+        row['shard_bytes_per_device'] = (shard_bytes.value - mark) // ndev
+    else:
+        step = build_update_step(module, cfg, donate=False)
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        row['shard_bytes_per_device'] = sum(
+            np.asarray(v).nbytes
+            for v in jax.tree_util.tree_leaves(batch))
+
+    # first-step loss from identical seeds: the cross-mesh parity probe
+    _, metrics = step(state, batch, lr)
+    row['loss'] = float(np.asarray(metrics['total']))
+    sec, flops, _bytes = time_compiled_step(step, state, batch, lr, steps)
+    row['wall_steps_per_sec'] = round(1.0 / sec, 4)
+    row['flops_per_step'] = flops
+
+    # per-shard strong-scaling projection: each device of a real ndev-mesh
+    # runs the B/ndev program; its measured single-device rate is the
+    # global step rate collectives aside (on a virtual one-core mesh the
+    # wall clock above time-slices all ndev shards, so it cannot show this)
+    if ndev > 1 and B % ndev == 0:
+        m2, c2, b2, s2 = headline_setup(B // ndev, T, seed=0)
+        step2 = build_update_step(m2, c2, donate=False)
+        b2 = jax.tree_util.tree_map(jnp.asarray, b2)
+        sec2, _f, _b = time_compiled_step(step2, s2, b2, lr, steps)
+        row['projected_steps_per_sec'] = round(1.0 / sec2, 4)
+    else:
+        row['projected_steps_per_sec'] = row['wall_steps_per_sec']
+    print(json.dumps(row), flush=True)
+
+
+_FORCE_DEV_RE = r'--xla_force_host_platform_device_count=\d+'
+
+
+def run_mesh(probe: dict):
+    """BENCH_MODE=mesh: SGD-throughput scaling of the sharded learner.
+
+    Env knobs (CI smoke shrinks them): BENCH_MESH_DEVICES ('1,2,4,8'),
+    BENCH_MESH_BATCH (global batch, default 128), BENCH_MESH_T (forward
+    steps, default 16), BENCH_MESH_STEPS (timed steps per row, default 5).
+    On the CPU backend each mesh size runs on XLA host-device partitioning
+    (a virtual mesh); real accelerators use the first ndev devices.
+    """
+    import re
+
+    cpu = probe.get('backend') == 'cpu'
+    ndevs = [int(x) for x in os.environ.get(
+        'BENCH_MESH_DEVICES', '1,2,4,8').split(',') if x.strip()]
+    rows = []
+    for ndev in ndevs:
+        if not cpu and int(probe.get('n', 1)) < ndev:
+            continue   # not enough physical devices; no virtualizing a TPU
+        env = dict(os.environ, BENCH_MESH_CHILD=str(ndev))
+        if cpu:
+            flags = re.sub(_FORCE_DEV_RE, '', env.get('XLA_FLAGS', ''))
+            env['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=%d'
+                % ndev).strip()
+            env['JAX_PLATFORMS'] = 'cpu'
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        _CHILDREN.append(proc)
+        out, _ = proc.communicate()
+        try:
+            row = json.loads(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            row = {'ndev': ndev, 'error': 'child rc=%s' % proc.returncode}
+        rows.append(row)
+
+    good = [r for r in rows if 'error' not in r]
+    if not good:
+        emit(error='no mesh size produced a measurement',
+             rows=rows, device=probe.get('device_kind', 'unknown'))
+        return
+    base = min(good, key=lambda r: r['ndev'])
+    # scaling: wall clock where the mesh is real hardware, the per-shard
+    # projection where it is host-virtualized (one core serializes shards)
+    key = 'wall_steps_per_sec' if not cpu else 'projected_steps_per_sec'
+    for r in good:
+        r['scaling_vs_1dev'] = round(r[key] / base['wall_steps_per_sec'], 3)
+        r['loss_rel_err'] = (abs(r['loss'] - base['loss'])
+                             / max(abs(base['loss']), 1e-12))
+    peak = good[-1]
+    at4 = next((r for r in good if r['ndev'] == 4), peak)
+    emit(peak[key], at4['scaling_vs_1dev'],
+         backend=probe.get('backend', 'unknown'),
+         device=probe.get('device_kind', 'unknown'),
+         batch=base.get('batch'), forward_steps=base.get('forward_steps'),
+         devices_measured=[r['ndev'] for r in good],
+         rows=rows,
+         virtual_mesh=cpu,
+         scaling_at_max=peak['scaling_vs_1dev'],
+         max_loss_rel_err=max(r['loss_rel_err'] for r in good),
+         vs_baseline_def=('steps/sec scaling at 4 devices vs the 1-device '
+                          'step at the same global batch; %s'
+                          % ('per-shard strong-scaling projection (B/ndev '
+                             'single-device rate) on the host-virtualized '
+                             'mesh — the wall column time-slices every '
+                             'shard onto this host\'s cores' if cpu
+                             else 'measured wall clock')),
+         geometry=('headline' if base.get('batch') == 128
+                   and base.get('forward_steps') == 16 else 'dryrun'))
+
+
 def _last_measured() -> str:
     """The newest on-silicon bench-headline row, summarized for the
     backend-unavailable JSON line — so a wedged tunnel at the driver's
@@ -637,6 +800,11 @@ def _last_measured() -> str:
 
 
 def main():
+    if os.environ.get('BENCH_MESH_CHILD'):
+        # mesh-mode measurement subprocess: one JSON row, no probe/alarm
+        # machinery (the parent owns the deadline and emit contract)
+        _mesh_child()
+        return
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
     deadline = float(os.environ.get('BENCH_DEADLINE_SEC', '600'))
@@ -655,6 +823,8 @@ def main():
             run_ingest(probe)
         elif _active_mode() == 'actor':
             run_actor(probe)
+        elif _active_mode() == 'mesh':
+            run_mesh(probe)
         else:
             run_bench(probe)
     except Exception as exc:  # noqa: BLE001 — the contract is: always emit

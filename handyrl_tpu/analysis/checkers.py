@@ -62,6 +62,10 @@ SCOPE_GL002 = (
     'handyrl_tpu/ops/targets.py',
     'handyrl_tpu/ops/replay.py',
     'handyrl_tpu/device_generation.py',
+    # the NamedSharding/pjit entry points: the partition-rule-built train
+    # step and the mesh staging helpers share the no-host-sync contract
+    'handyrl_tpu/parallel/partition.py',
+    'handyrl_tpu/parallel/mesh.py',
 )
 
 SCOPE_GL003_EXEMPT = (

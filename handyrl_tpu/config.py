@@ -56,6 +56,15 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'metrics_jsonl': '',          # optional structured metrics path
     'distributed': {},            # multi-host learner: coordinator_address / num_processes / process_id
 
+    # mesh partitioning (parallel/partition.py, docs/large_scale_training.md
+    # "Mesh-sharded training"): the learner's compiled steps take explicit
+    # NamedShardings over the ('data', 'model') mesh; regex partition rules
+    # map the param/optimizer pytree to replicate-vs-sharded specs
+    'parallel': {
+        'model_parallel': 1,      # width of the mesh's 'model' axis (tensor parallelism); devices/model_parallel becomes the 'data' axis the batch shards over
+        'partition_rules': [],    # [[regex, spec], ...] over '/'-joined param/optimizer paths, first match wins; spec = null/[] replicate, 'data'/'model' shard dim 0, or a per-dim axis list like [null, 'model']. [] = replicate everything (pure data parallelism); a trailing catch-all replicate rule is implied
+    },
+
     # distributed-fleet fault tolerance (docs/large_scale_training.md):
     # heartbeats, silent-peer detach, supervised reconnect, task re-issue
     'fault_tolerance': {
@@ -272,6 +281,23 @@ def validate(args: Dict[str, Any]) -> None:
                 'reprobe_initial_delay', 'reprobe_max_delay'):
         if inf.get(key) is not None:
             assert float(inf[key]) > 0, 'inference.%s must be > 0' % key
+    par = ta.get('parallel') or {}
+    assert int(par.get('model_parallel', 1)) >= 1, \
+        'parallel.model_parallel must be >= 1 (1 = no tensor parallelism)'
+    rules = par.get('partition_rules') or []
+    assert isinstance(rules, (list, tuple)), \
+        'parallel.partition_rules must be a list of [regex, spec] pairs'
+    import re as _re
+    for entry in rules:
+        assert isinstance(entry, (list, tuple)) and len(entry) == 2, \
+            'each partition rule is a [regex, spec] pair, got %r' % (entry,)
+        pattern, spec = entry
+        _re.compile(str(pattern))   # raises on an invalid regex
+        axes = [spec] if isinstance(spec, str) or spec is None else list(spec)
+        for axis in axes:
+            assert axis in (None, 'null', '', 'data', 'model'), \
+                "partition-rule axes must be null, 'data' or 'model' " \
+                '(got %r in %r)' % (axis, entry)
     if ta.get('batcher_shared_memory'):
         assert ta.get('batcher_processes'), \
             'batcher_shared_memory requires batcher_processes (the thread ' \

@@ -202,11 +202,30 @@ class FusedPipeline:
                 mesh, gen_ingest, sgd_tail, pack, b_loc)
 
         # donate everything the pipeline owns plus the train state; actor
-        # params and the EMA scalar are plain (re-used) inputs
-        self._warmup = jax.jit(warmup,
-                               donate_argnums=(1, 2, 3, 4, 5, 6, 7))
-        self._fused = jax.jit(fused,
-                              donate_argnums=tuple(range(1, 10)))
+        # params and the EMA scalar are plain (re-used) inputs. On a mesh
+        # the program boundary is TYPED with explicit NamedShardings (the
+        # same vocabulary the partition-rule engine speaks): loop state
+        # sharded along 'data', actor params / train state / the packed
+        # host fetch replicated — placement is part of the program, not an
+        # accident of where the caller left the inputs.
+        if mesh is None:
+            self._warmup = jax.jit(warmup,
+                                   donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+            self._fused = jax.jit(fused,
+                                  donate_argnums=tuple(range(1, 10)))
+        else:
+            from ..parallel.mesh import batch_sharding, replicated_sharding
+            R, D = replicated_sharding(mesh), batch_sharding(mesh)
+            self._warmup = jax.jit(
+                warmup,
+                in_shardings=(R, D, D, D, D, D, D, D),
+                out_shardings=(D, D, D, D, D, D, D, R),
+                donate_argnums=(1, 2, 3, 4, 5, 6, 7))
+            self._fused = jax.jit(
+                fused,
+                in_shardings=(R, R, D, D, D, D, D, D, D, R),
+                out_shardings=(R, D, D, D, D, D, D, D, R),
+                donate_argnums=tuple(range(1, 10)))
         self._pending = None   # (pack_future, has_metrics), one deep
         self.ring_size_host = 0
         self.ring_min_host = 0          # min ring size across shards

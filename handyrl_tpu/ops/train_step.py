@@ -134,11 +134,20 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
     return update
 
 
-def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
+def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True,
+                      state_shardings=None):
     """Returns update(state, batch, lr) -> (state, metrics), jit-compiled.
 
     ``metrics`` carries the per-term loss sums and the turn count of the
     batch (the reference's ``dcnt``) as device scalars.
+
+    On a mesh the program carries explicit NamedSharding types: the batch
+    shards along 'data', and the TrainState layout comes from
+    ``state_shardings`` — the per-leaf NamedSharding pytree the partition-
+    rule engine builds (parallel/partition.py tree_shardings); None keeps
+    the fully-replicated (pure data-parallel) layout. The same shardings
+    type the outputs, so the donated state round-trips through every step
+    without a reshard.
     """
     # Resolve the Pallas-vs-scan target path NOW, outside any trace: the
     # probe compiles and runs a real kernel on the backend, which cannot
@@ -153,10 +162,11 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
 
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
+    state_sh = state_shardings if state_shardings is not None else repl
     return jax.jit(
         update,
-        in_shardings=(repl, data, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data, repl),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -164,7 +174,7 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True):
 def build_replay_update(module, cfg: LossConfig, capacity: int,
                         batch_size: int, num_steps: int,
                         default_lr: float = 3e-8, mesh=None,
-                        spec_fn=None):
+                        spec_fn=None, state_shardings=None):
     """Fused replay-mode trainer: K SGD steps in ONE compiled program.
 
     The per-step host round trip (sample dispatch + update dispatch + PRNG
@@ -230,9 +240,12 @@ def build_replay_update(module, cfg: LossConfig, capacity: int,
     if mesh is None:
         return jax.jit(fused, donate_argnums=(0, 2))
     repl = replicated_sharding(mesh)
+    # the ring stays replicated (each device gathers its batch from a local
+    # replica); the TrainState layout comes from the partition-rule engine
+    state_sh = state_shardings if state_shardings is not None else repl
     return jax.jit(
         fused,
-        in_shardings=(repl, repl, repl, repl, repl, repl),
-        out_shardings=(repl, repl, repl),
+        in_shardings=(state_sh, repl, repl, repl, repl, repl),
+        out_shardings=(state_sh, repl, repl),
         donate_argnums=(0, 2),
     )

@@ -4,7 +4,15 @@ Replaces the reference's single-node nn.DataParallel (train.py:339-340) with
 jax.sharding over a named mesh: the batch is sharded along 'data', params are
 replicated, and XLA inserts the gradient all-reduce over ICI. A 'model' axis
 is reserved so tensor-parallel specs can be added without changing call
-sites.
+sites (parallel/partition.py maps regex rules over the param/optimizer
+pytree onto these axes).
+
+``shard_batch`` is the host->device staging primitive: each device receives
+ONLY its shard's slice of a host batch (``jax.make_array_from_callback``
+builds per-device buffers from host slices — never a full-array replication
+that is then resharded), and the bytes actually staged are counted on the
+``mesh_shard_bytes_total`` telemetry counter so the 1/N-per-device transfer
+contract is observable, not assumed.
 """
 
 from __future__ import annotations
@@ -15,8 +23,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
+
 DATA_AXIS = 'data'
 MODEL_AXIS = 'model'
+
+# host->device bytes staged by shard_batch, summed over the addressable
+# shards it built (per-device bytes = total batch bytes / data-axis size;
+# a replicated placement of the same batch would count devices x bytes)
+_SHARD_BYTES = telemetry.counter('mesh_shard_bytes_total')
 
 
 def make_mesh(devices: Optional[Sequence] = None, model_parallel: int = 1) -> Mesh:
@@ -37,10 +52,47 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch):
-    """Device-put a host batch with its leading dim sharded over 'data'."""
-    spec = batch_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), batch)
+def _place_host_leaf(arr: np.ndarray, sharding: NamedSharding):
+    """Build the sharded device array from per-shard HOST slices: each
+    addressable device is handed exactly its slice's bytes. The counter
+    reflects what actually crossed to each device (replicating dims — the
+    'model' axis, or a scalar — count once per holding device, which is
+    what the wire really carries)."""
+    out = jax.make_array_from_callback(arr.shape, sharding,
+                                       lambda idx: arr[idx])
+    if telemetry.enabled():
+        _SHARD_BYTES.inc(sum(s.data.nbytes for s in out.addressable_shards))
+    return out
+
+
+def shard_batch(mesh: Mesh, batch, specs=None):
+    """Place a batch with its leading dim sharded over 'data'.
+
+    Host (numpy) leaves are staged per shard — device i receives only its
+    1/N slice. Leaves already on device are resharded by XLA
+    (``device_put``), which is what the fused pipeline's loop-state layout
+    pass wants. Scalars replicate. ``specs`` optionally overrides the
+    per-leaf PartitionSpec pytree (prefix or full; default = P('data')).
+    """
+    data = batch_sharding(mesh)
+    repl = replicated_sharding(mesh)
+
+    def place(x, spec=None):
+        sharding = (NamedSharding(mesh, spec) if isinstance(spec, P)
+                    else spec) if spec is not None else None
+        if isinstance(x, jax.Array):
+            return jax.device_put(x, sharding or
+                                  (data if np.ndim(x) else repl))
+        arr = np.asarray(x)
+        if sharding is None:
+            sharding = data if arr.ndim else repl
+        return _place_host_leaf(arr, sharding)
+
+    if specs is None:
+        return jax.tree_util.tree_map(place, batch)
+    return jax.tree_util.tree_map(
+        place, batch, specs,
+        is_leaf=lambda x: isinstance(x, (P, NamedSharding)))
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -1,0 +1,226 @@
+"""Regex partition rules: named pytree paths -> PartitionSpec -> NamedSharding.
+
+The learner's compiled programs (ops/train_step.py, ops/fused_pipeline.py)
+take explicit in/out shardings over the ('data', 'model') mesh instead of
+relying on input placement. This module is the ONE place those shardings
+come from: a ``match_partition_rules``-style engine (the fmengine/EasyLM
+idiom) walks the param/optimizer/batch-stats pytree, names every leaf by
+its '/'-joined key path (e.g. ``params/params/conv0/kernel`` or
+``opt_state/2/mu/params/head/bias``), and assigns the spec of the FIRST
+rule whose regex matches. Scalars and single-element leaves always
+replicate — a partitioned Adam ``count`` makes no sense on any mesh.
+
+Data parallelism is the default (``DEFAULT_RULES`` replicates every
+parameter; the batch shards along 'data'); tensor-parallel layouts are a
+config edit away (``parallel.partition_rules`` in config.yaml), not a code
+change — the 'model' mesh axis already exists for them.
+
+The same layout vocabulary describes checkpoints: ``checkpoint_layout``
+summarizes the mesh shape + rules into the manifest written next to
+``trainer_state.ckpt`` / ``models/<epoch>.ckpt`` (utils/fs.py), so a
+checkpoint saved under one device/host count restores under another with
+the mismatch logged instead of silently assumed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, replicated_sharding
+
+# pure data parallelism: every parameter/optimizer leaf replicated, the
+# batch sharded along 'data' (the Podracer layout) — what the learner runs
+# unless config parallel.partition_rules says otherwise
+DEFAULT_RULES: Tuple[Tuple[str, P], ...] = ((r'.*', P()),)
+
+# checkpoint layout-manifest format version (bump on incompatible change)
+LAYOUT_FORMAT = 1
+
+
+def leaf_path(path) -> str:
+    """'/'-joined name of a tree_flatten_with_path key path."""
+    parts = []
+    for key in path:
+        if isinstance(key, jax.tree_util.DictKey):
+            parts.append(str(key.key))
+        elif isinstance(key, jax.tree_util.SequenceKey):
+            parts.append(str(key.idx))
+        elif isinstance(key, jax.tree_util.GetAttrKey):
+            parts.append(str(key.name))
+        elif isinstance(key, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(key.key))
+        else:   # unknown key kind: fall back to its repr, stripped
+            parts.append(str(key).strip('.[]\'"'))
+    return '/'.join(parts)
+
+
+def spec_from_entry(entry) -> P:
+    """Config-form spec -> PartitionSpec.
+
+    ``None``/``[]`` replicate; a string names one mesh axis; a list maps
+    array dims to axes positionally, with ``None``/``'null'``/``''``
+    entries unsharded (``['data']`` -> P('data'), ``[None, 'model']`` ->
+    P(None, 'model')).
+    """
+    if entry is None:
+        return P()
+    if isinstance(entry, P):
+        return entry
+    if isinstance(entry, str):
+        return P(entry)
+    axes = [None if a in (None, 'null', '') else str(a) for a in entry]
+    return P(*axes)
+
+
+def normalize_rules(rules) -> Tuple[Tuple[str, P], ...]:
+    """[(regex, config-form spec), ...] -> ((regex, PartitionSpec), ...)."""
+    out = []
+    for pattern, spec in rules:
+        out.append((str(pattern), spec_from_entry(spec)))
+    return tuple(out)
+
+
+def rules_from_config(args: Dict[str, Any]) -> Tuple[Tuple[str, P], ...]:
+    """The train_args['parallel'] rule set, catch-all-replicate-terminated.
+
+    An operator writing rules for a few kernels must not crash every
+    unmatched bias, so config-sourced rule sets get the DEFAULT_RULES
+    catch-all appended; ``match_partition_rules`` itself stays strict for
+    library callers.
+    """
+    par = args.get('parallel') or {}
+    user = par.get('partition_rules') or ()
+    if not user:
+        return DEFAULT_RULES
+    return normalize_rules(user) + DEFAULT_RULES
+
+
+def pure_data_parallel(rules) -> bool:
+    """True when every rule replicates (no tensor-parallel specs) — the
+    precondition for the shard_map'd fused pipeline, whose gradient psum
+    assumes a fully replicated train state."""
+    return all(len(tuple(spec)) == 0 for _, spec in normalize_rules(rules))
+
+
+def match_partition_rules(rules, tree) -> Any:
+    """Pytree of PartitionSpec for ``tree`` per the first matching rule.
+
+    Scalar / single-element leaves replicate regardless of rules. A leaf
+    no rule matches raises — end the rule list with ``('.*', P())`` (what
+    ``rules_from_config`` does for config-sourced rules) to default to
+    replication instead.
+    """
+    rules = normalize_rules(rules)
+
+    def spec_of(path, leaf):
+        shape = tuple(getattr(leaf, 'shape', ()) or ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = leaf_path(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return spec
+        raise ValueError(
+            'no partition rule matches leaf %r (shape %s); end the rule '
+            'list with a catch-all (".*", []) to replicate by default'
+            % (name, shape))
+
+    return jax.tree_util.tree_map_with_path(spec_of, tree)
+
+
+def validate_specs(mesh: Mesh, tree, specs) -> None:
+    """Fail fast when a spec's sharded dims don't divide the mesh axes —
+    the XLA error for that names neither the leaf nor the rule."""
+    def check(path, leaf, spec):
+        shape = tuple(getattr(leaf, 'shape', ()) or ())
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+            size = 1
+            for a in axes:
+                if a not in mesh.shape:
+                    raise ValueError(
+                        'partition spec %s for %r names unknown mesh axis '
+                        '%r (mesh axes: %s)' % (spec, leaf_path(path), a,
+                                                tuple(mesh.shape)))
+                size *= int(mesh.shape[a])
+            if dim >= len(shape) or shape[dim] % size != 0:
+                raise ValueError(
+                    'leaf %r shape %s dim %d is not divisible by mesh '
+                    'axis %r (size %d)' % (leaf_path(path), shape, dim,
+                                           axis, size))
+
+    jax.tree_util.tree_map_with_path(check, tree, specs)
+
+
+def tree_shardings(mesh: Mesh, tree, rules=DEFAULT_RULES) -> Any:
+    """Pytree of NamedSharding for ``tree`` from the rule engine, with the
+    divisibility of every sharded dim validated up front."""
+    specs = match_partition_rules(rules, tree)
+    validate_specs(mesh, tree, specs)
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_shardings(mesh: Mesh) -> NamedSharding:
+    """The batch prefix sharding: every leaf splits its leading (batch)
+    dim along 'data' (a bare sharding is a pytree prefix in jax.jit)."""
+    return batch_sharding(mesh)
+
+
+def host_to_global_batch(mesh: Mesh, local_batch):
+    """Multi-process meshes: assemble the GLOBAL sharded batch from each
+    process's local rows (every process holds its own slice; nothing is
+    replicated or gathered). Single-process meshes should use
+    ``mesh.shard_batch`` instead — it also counts transfer bytes."""
+    sharding = batch_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)), local_batch)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint layout manifests (mesh-shape-portable restore)
+
+
+def serializable_rules(rules) -> list:
+    """((regex, PartitionSpec), ...) -> JSON-safe [[regex, [axes...]], ...]."""
+    out = []
+    for pattern, spec in normalize_rules(rules):
+        out.append([pattern, [list(a) if isinstance(a, (tuple, list))
+                              else a for a in spec]])
+    return out
+
+
+def checkpoint_layout(mesh: Optional[Mesh], rules=DEFAULT_RULES,
+                      steps: Optional[int] = None) -> Dict[str, Any]:
+    """The layout manifest describing how a checkpoint's train state was
+    laid out at save time. The state itself is serialized as full
+    (host-gathered) arrays, so restore under ANY mesh shape is exact; the
+    manifest makes the mesh change explicit instead of silent."""
+    layout: Dict[str, Any] = {
+        'format': LAYOUT_FORMAT,
+        'mesh': ({axis: int(n) for axis, n in mesh.shape.items()}
+                 if mesh is not None else None),
+        'devices': int(np.prod(list(mesh.shape.values()))) if mesh is not None
+                   else 1,
+        'processes': int(jax.process_count()),
+        'partition_rules': serializable_rules(rules),
+    }
+    if steps is not None:
+        layout['steps'] = int(steps)
+    return layout
+
+
+def describe_mesh(layout: Optional[Dict[str, Any]]) -> str:
+    """Human-readable mesh description of a layout manifest (logging)."""
+    if not layout or not layout.get('mesh'):
+        return 'single device'
+    mesh = layout['mesh']
+    return 'x'.join('%s=%d' % (axis, mesh[axis]) for axis in sorted(mesh))

@@ -356,24 +356,48 @@ class Trainer:
         self.cfg = LossConfig.from_args(args)
         self.device_cfg = self.cfg   # may be relayered by the ingest gate
 
+        # mesh construction: the 'data' axis carries the batch, the 'model'
+        # axis (config parallel.model_parallel) is reserved for tensor-
+        # parallel partition rules. jax.devices() is the GLOBAL set, so on
+        # a multi-host job (parallel/multihost.py initialized by
+        # train_main) the mesh spans every process's devices.
+        par = args.get('parallel') or {}
+        model_parallel = max(1, int(par.get('model_parallel') or 1))
         n_dev = len(jax.devices())
         self.mesh = None
         if n_dev > 1:
-            if args['batch_size'] % n_dev == 0:
-                self.mesh = make_mesh()
+            data_size = n_dev // model_parallel
+            if n_dev % model_parallel != 0:
+                _LOG.warning('parallel.model_parallel %d does not divide '
+                             '%d devices; training on a single device',
+                             model_parallel, n_dev)
+            elif args['batch_size'] % data_size == 0:
+                self.mesh = make_mesh(model_parallel=model_parallel)
             else:
-                _LOG.warning('batch_size %d not divisible by %d devices; '
-                             'training on a single device',
-                             args['batch_size'], n_dev)
-        # the step donates its input state (params/opt buffers reused in
-        # place); the actor-facing wrapper keeps its own copy of the params,
-        # refreshed only at epoch boundaries
-        self.update_step = build_update_step(wrapper.module, self.cfg,
-                                             self.mesh, donate=True)
+                _LOG.warning('batch_size %d not divisible by the %d-way '
+                             'data axis; training on a single device',
+                             args['batch_size'], data_size)
         self.state: Optional[TrainState] = None
         if wrapper.params is not None:
             own_params = jax.tree_util.tree_map(jnp.array, wrapper.params)
             self.state = init_train_state(own_params)
+        # partition rules (parallel/partition.py): regex over the named
+        # param/optimizer/batch-stats pytree -> replicate-vs-sharded specs.
+        # The derived NamedSharding pytree types the compiled train steps'
+        # inputs AND outputs, and is what checkpoints describe in their
+        # layout manifest.
+        from .parallel.partition import rules_from_config, tree_shardings
+        self.partition_rules = rules_from_config(args)
+        self.state_sharding = None
+        if self.mesh is not None and self.state is not None:
+            self.state_sharding = tree_shardings(self.mesh, self.state,
+                                                 self.partition_rules)
+        # the step donates its input state (params/opt buffers reused in
+        # place); the actor-facing wrapper keeps its own copy of the params,
+        # refreshed only at epoch boundaries
+        self.update_step = build_update_step(
+            wrapper.module, self.cfg, self.mesh, donate=True,
+            state_shardings=self.state_sharding)
 
         self.default_lr = 3e-8
         self.data_cnt_ema = args['batch_size'] * args['forward_steps']
@@ -475,6 +499,7 @@ class Trainer:
             self.wrapper.module, cfg, capacity=self.replay.capacity,
             batch_size=self.args['batch_size'], num_steps=self.fused_steps,
             default_lr=self.default_lr, mesh=self.mesh,
+            state_shardings=self.state_sharding,
             # window shapes resolved at trace time (first update): by
             # then either the windower ring (device ingest) or the
             # DeviceReplay (host push) has seen its first windows
@@ -525,6 +550,18 @@ class Trainer:
                    'data_cnt_ema': self.data_cnt_ema}
         return serialization.to_bytes(payload)
 
+    def place_state(self, state: TrainState) -> TrainState:
+        """Lay a (host or misplaced) TrainState out per the partition
+        rules — the layout the compiled steps' in_shardings expect. The
+        serialized checkpoint holds full host arrays, so this is also what
+        makes restores mesh-shape-portable: whatever mesh wrote the bytes,
+        placement happens under the CURRENT mesh."""
+        if self.mesh is None:
+            return state
+        from .parallel.mesh import replicated_sharding
+        return jax.device_put(state, self.state_sharding
+                              or replicated_sharding(self.mesh))
+
     def load_state_bytes(self, raw: bytes):
         from flax import serialization
         template = {'state': self.state, 'steps': self.steps,
@@ -535,7 +572,7 @@ class Trainer:
         state = jax.tree_util.tree_map(jnp.asarray, payload['state'])
         if isinstance(state, tuple):
             state = TrainState(*state)
-        self.state = state
+        self.state = self.place_state(state)
         self.steps = int(payload['steps'])
         self.data_cnt_ema = float(payload['data_cnt_ema'])
 
@@ -1130,15 +1167,34 @@ class Learner:
         if self._resume:
             state_path = self.trainer_state_path()
             if os.path.exists(state_path):
-                from .utils.fs import read_verified_bytes
+                from .parallel.partition import checkpoint_layout, describe_mesh
+                from .utils.fs import read_layout_manifest, read_verified_bytes
                 raw = read_verified_bytes(state_path)
+                layout, lreason = read_layout_manifest(state_path)
+                if lreason == 'unparsable':
+                    # corrupt manifest = untrustworthy pair, same as a CRC
+                    # failure: degrade to params-only resume
+                    raw = None
                 if raw is None:
                     _LOG.error('discarding corrupt trainer_state.ckpt '
-                               '(checksum mismatch or truncation); the '
-                               'optimizer restarts fresh from the model '
-                               'checkpoint')
+                               '(checksum mismatch, truncation, or corrupt '
+                               'layout manifest); the optimizer restarts '
+                               'fresh from the model checkpoint')
                     telemetry.counter('guard_ckpt_fallbacks_total').inc()
                 else:
+                    # mesh-portable restore: the state is full host arrays,
+                    # so a mesh-shape change is legal — log it explicitly
+                    here = checkpoint_layout(self.trainer.mesh,
+                                             self.trainer.partition_rules)
+                    if layout is not None and (
+                            layout.get('mesh') != here['mesh']
+                            or layout.get('processes') != here['processes']):
+                        print('mesh-portable restore: checkpoint written '
+                              'under %s (%d process(es)), restoring onto '
+                              '%s (%d process(es))'
+                              % (describe_mesh(layout),
+                                 int(layout.get('processes') or 1),
+                                 describe_mesh(here), here['processes']))
                     try:
                         self.trainer.load_state_bytes(raw)
                         print('resumed trainer state (steps %d)'
@@ -1208,11 +1264,21 @@ class Learner:
         # atomic (temp + fsync + rename) plus a CRC32 sidecar manifest: a
         # crash mid-write must never leave a truncated latest.ckpt /
         # trainer_state.ckpt, and resume verifies the checksum so silent
-        # on-disk corruption falls back instead of poisoning the restart
+        # on-disk corruption falls back instead of poisoning the restart.
+        # A mesh-layout manifest rides along: checkpoints serialize full
+        # host arrays, so they restore under ANY device/host count — the
+        # manifest records what wrote them so the mesh change is logged,
+        # and a corrupt manifest disqualifies the pair like a bad CRC.
+        from .parallel.partition import checkpoint_layout
+        from .utils.fs import write_layout_manifest
+        layout = checkpoint_layout(self.trainer.mesh,
+                                   self.trainer.partition_rules, steps=steps)
         for path in (self.model_path(self.model_epoch), self.latest_model_path()):
             checksummed_write_bytes(path, raw)
+            write_layout_manifest(path, layout)
         if state_blob is not None:
             checksummed_write_bytes(self.trainer_state_path(), state_blob)
+            write_layout_manifest(self.trainer_state_path(), layout)
         self._gc_checkpoints()
 
     # -- checkpoint integrity / retention / rollback -----------------------
@@ -1225,11 +1291,20 @@ class Learner:
         candidates = [self.model_epoch] + [
             e for e in reversed(guard_mod.numbered_checkpoints(model_dir))
             if e < self.model_epoch]
+        from .utils.fs import read_layout_manifest
         for epoch in candidates:
             path = self.model_path(epoch)
             ok, reason = verify_checkpoint(path)
             if not ok:
                 _LOG.error('discarding checkpoint %s: %s', path, reason)
+                telemetry.counter('guard_ckpt_fallbacks_total').inc()
+                continue
+            # a PRESENT but corrupt layout manifest disqualifies the pair
+            # exactly like a failed CRC (missing = legacy, loadable)
+            _layout, lreason = read_layout_manifest(path)
+            if lreason == 'unparsable':
+                _LOG.error('discarding checkpoint %s: corrupt layout '
+                           'manifest', path)
                 telemetry.counter('guard_ckpt_fallbacks_total').inc()
                 continue
             try:
@@ -1306,11 +1381,7 @@ class Learner:
             tr.guard.reset_streak()
             return
         epoch, blob = src
-        tr.load_state_bytes(blob)
-        if tr.mesh is not None:
-            from .parallel.mesh import replicated_sharding
-            tr.state = jax.device_put(tr.state,
-                                      replicated_sharding(tr.mesh))
+        tr.load_state_bytes(blob)   # place_state lays it back on the mesh
         tr.guard.reset_streak()
         tr.guard.rollbacks += 1
         telemetry.counter('guard_rollbacks_total').inc()
@@ -1335,7 +1406,7 @@ class Learner:
         keep = int(self.args.get('keep_checkpoints') or 0)
         if keep <= 0:
             return
-        from .utils.fs import sidecar_path
+        from .utils.fs import layout_path, sidecar_path
         model_dir = self.args.get('model_dir', 'models')
         epochs = guard_mod.numbered_checkpoints(model_dir)
         if len(epochs) <= keep:
@@ -1347,7 +1418,7 @@ class Learner:
             path = self.model_path(epoch)
             if os.path.abspath(path) in protected:
                 continue
-            for p in (path, sidecar_path(path)):
+            for p in (path, sidecar_path(path), layout_path(path)):
                 try:
                     os.unlink(p)
                 except OSError:
@@ -1728,11 +1799,18 @@ class Learner:
             if self.trainer.mesh is not None else 1
         eval_envs = int(args.get('eval_envs')
                         or max(4, args.get('generation_envs', 64) // 8))
+        # the shard_map'd fused pipeline is pure data parallelism: it
+        # requires a 1-wide 'model' axis and replicate-everything partition
+        # rules (tensor-parallel configs train through the jit paths, whose
+        # in/out shardings come from the rule engine)
+        from .parallel.partition import pure_data_parallel
         mesh_fused_ok = (
             self.trainer.mesh is None
             or (args.get('fused_pipeline', True)
                 and args.get('generation_envs', 64) % n_dev == 0
-                and args['batch_size'] % n_dev == 0))
+                and args['batch_size'] % n_dev == 0
+                and int(self.trainer.mesh.shape.get('model', 1)) == 1
+                and pure_data_parallel(self.trainer.partition_rules)))
         if self.trainer.mesh is not None and mesh_fused_ok \
                 and eval_envs % n_dev != 0:
             # eval_envs is only a throughput knob — round it up to the mesh

@@ -154,6 +154,34 @@ def test_gl002_real_tree_is_clean():
     assert check_gl002(sources) == []
 
 
+def test_gl002_traced_set_covers_partition_built_train_step():
+    """The NamedSharding/pjit entry points stay inside the no-host-sync
+    contract: the partition modules are GL002-scoped, and the traced-
+    function closure picks up the rule-built train step (the `update` the
+    builders hand to jax.jit with in/out shardings) plus the fused replay
+    program."""
+    import ast
+
+    from handyrl_tpu.analysis.checkers import (SCOPE_GL002, _parse,
+                                               _traced_functions, in_scope)
+
+    assert in_scope('handyrl_tpu/parallel/partition.py', SCOPE_GL002)
+    assert in_scope('handyrl_tpu/parallel/mesh.py', SCOPE_GL002)
+
+    sources = collect_sources(repo_root())
+    scoped = {p: s for p, s in sources.items() if in_scope(p, SCOPE_GL002)}
+    trees = {p: t for p, s in scoped.items()
+             if (t := _parse(s)) is not None}
+    traced = _traced_functions(trees)
+    step_names = {n.name for n in traced['handyrl_tpu/ops/train_step.py']
+                  if isinstance(n, ast.FunctionDef)}
+    assert 'update' in step_names      # build_update_step's jitted core
+    assert 'fused' in step_names       # build_replay_update's K-step scan
+    # and through the cross-module closure, the loss math it calls
+    assert any(isinstance(n, ast.FunctionDef)
+               for n in traced['handyrl_tpu/ops/losses.py'])
+
+
 # ---------------------------------------------------------------------------
 # GL003 raw write-mode open
 
