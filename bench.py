@@ -57,6 +57,19 @@ ACTOR_METRIC = ('fleet episodes/sec (HungryGeese/GeeseNet, gather+workers '
                 'per-worker B=1)')
 ACTOR_UNIT = 'episodes/sec'
 
+# BENCH_MODE=serve measures the standalone model-serving tier: sustained
+# requests/sec and tail latency (client-side p50/p95/p99) of a real
+# InferenceService subprocess (registry-resolved models, framed INFER
+# protocol over TCP, continuous batching) under a synthetic many-client
+# load, plus a measured graceful drain: a final wave of in-flight requests
+# is answered through a SIGTERM (no request dropped un-answered, exit 75).
+# vs_baseline is many-client req/s over single-client req/s measured by the
+# SAME harness — the continuous-batching concurrency gain.
+SERVE_METRIC = ('service requests/sec (standalone InferenceService, '
+                'registry-resolved models, framed INFER protocol over TCP, '
+                'synthetic many-client load)')
+SERVE_UNIT = 'requests/sec'
+
 # BENCH_MODE=mesh measures the mesh-sharded learner: SGD steps/sec of the
 # partition-rule-built NamedSharding/jit update step at 1/2/4/8 devices
 # (one subprocess per mesh size — the virtual-device count is fixed before
@@ -105,7 +118,8 @@ def emit(value=0.0, vs_baseline=0.0, **extra):
     _EMITTED = True
     metric, unit = {'ingest': (INGEST_METRIC, INGEST_UNIT),
                     'actor': (ACTOR_METRIC, ACTOR_UNIT),
-                    'mesh': (MESH_METRIC, MESH_UNIT)}.get(
+                    'mesh': (MESH_METRIC, MESH_UNIT),
+                    'serve': (SERVE_METRIC, SERVE_UNIT)}.get(
                         _active_mode(), (METRIC, UNIT))
     line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
             'vs_baseline': round(float(vs_baseline), 2)}
@@ -773,6 +787,180 @@ def run_mesh(probe: dict):
                    and base.get('forward_steps') == 16 else 'dryrun'))
 
 
+def _serve_client_load(host, port, model, obs, legal, n_clients, warmup,
+                       requests, base_seed):
+    """Drive ``n_clients`` concurrent ServiceClients (one thread each) at
+    the service: per-client warmup then ``requests`` timed sequential round
+    trips. Returns (requests/sec over the timed span, latency list,
+    error count)."""
+    import threading
+    from handyrl_tpu.generation import sample_seed
+    from handyrl_tpu.serving.client import ServiceClient
+
+    latencies, errors = [], [0]
+    spans = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients)
+
+    def run(ci):
+        client = ServiceClient(host, port, timeout=60.0, name='c%d' % ci)
+        mine = []
+        try:
+            for k in range(warmup):
+                client.request(model, obs, legal=legal,
+                               seed=sample_seed(base_seed, (ci, k), 0))
+            barrier.wait(timeout=120)
+            t_start = time.monotonic()
+            for k in range(requests):
+                t0 = time.monotonic()
+                client.request(model, obs, legal=legal,
+                               seed=sample_seed(base_seed,
+                                                (ci, warmup + k), 0))
+                mine.append(time.monotonic() - t0)
+            t_end = time.monotonic()
+            with lock:
+                latencies.extend(mine)
+                spans.append((t_start, t_end))
+        except Exception:
+            with lock:
+                errors[0] += 1
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=run, args=(ci,),
+                                name='serve-bench-%d' % ci)
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if not spans:
+        return 0.0, [], errors[0]
+    span = max(e for _s, e in spans) - min(s for s, _e in spans)
+    return len(latencies) / max(span, 1e-9), latencies, errors[0]
+
+
+def run_serve(probe: dict):
+    """BENCH_MODE=serve: the standalone serving tier, CPU-measurable.
+
+    Env knobs (CI smoke shrinks them): BENCH_SERVE_CLIENTS (default 8),
+    BENCH_SERVE_REQUESTS (timed requests per client, default 40),
+    BENCH_SERVE_WARMUP (per client, default 4), BENCH_SERVE_ENV (default
+    HungryGeese), BENCH_SERVE_WAIT_MS (engine batch_wait_ms, default 2),
+    BENCH_SERVE_DRAIN (in-flight requests per client through the SIGTERM,
+    default 3).
+    """
+    import contextlib
+    import shutil
+    import signal as _signal
+    import tempfile
+    import numpy as np
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.generation import sample_seed
+    from handyrl_tpu.model import ModelWrapper
+    from handyrl_tpu.serving.client import ServiceClient
+    from handyrl_tpu.serving.registry import ModelRegistry
+
+    env_name = os.environ.get('BENCH_SERVE_ENV', 'HungryGeese')
+    n_clients = int(os.environ.get('BENCH_SERVE_CLIENTS', '8'))
+    requests = int(os.environ.get('BENCH_SERVE_REQUESTS', '40'))
+    warmup = int(os.environ.get('BENCH_SERVE_WARMUP', '4'))
+    wait_ms = os.environ.get('BENCH_SERVE_WAIT_MS', '2')
+    drain_n = int(os.environ.get('BENCH_SERVE_DRAIN', '3'))
+
+    env = make_env({'env': env_name})
+    env.reset()
+    obs = env.observation(env.players()[0])
+    legal = env.legal_actions(env.players()[0])
+    wrapper = ModelWrapper(env.net(), seed=7)
+    wrapper.ensure_params(obs)
+
+    root = tempfile.mkdtemp(prefix='bench_serve_registry.')
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            ModelRegistry(root).publish('bench', snapshot=wrapper.snapshot(),
+                                        version=1, steps=1, promote=True)
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'handyrl_tpu.serving',
+             '--env', env_name, '--registry', root, '--port', '0',
+             '--line', 'bench', '--wait-ms', str(wait_ms),
+             '--max-clients', str(n_clients + 4)],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        _CHILDREN.append(proc)
+        ready = json.loads(proc.stdout.readline())['serving_ready']
+        port = int(ready['port'])
+        model = 'bench@champion'
+
+        # single-client reference first: the vs_baseline denominator (what
+        # one sequential client extracts from the same service)
+        base_rps, _lat1, err1 = _serve_client_load(
+            'localhost', port, model, obs, legal, 1, warmup,
+            max(8, requests // 2), base_seed=29)
+        many_rps, latencies, err_n = _serve_client_load(
+            'localhost', port, model, obs, legal, n_clients, warmup,
+            requests, base_seed=31)
+
+        status_client = ServiceClient('localhost', port, name='status')
+        status = status_client.status(timeout=30)
+        fill = (status.get('engine_requests', 0)
+                / max(1, status.get('engine_batches', 1)))
+
+        # measured graceful drain: every in-flight request through the
+        # SIGTERM must be ANSWERED (ok or an explicit drain error), and the
+        # service must exit 75 (the PreemptionGuard supervisor contract)
+        rids = [status_client.submit(model, obs, legal=legal,
+                                     seed=sample_seed(37, (0, k), 0))
+                for k in range(drain_n * n_clients)]
+        t_term = time.monotonic()
+        proc.send_signal(_signal.SIGTERM)
+        drained = unanswered = 0
+        for rid in rids:
+            try:
+                status_client.collect(rid, timeout=30)
+                drained += 1
+            except TimeoutError:
+                unanswered += 1
+            except Exception:
+                drained += 1          # an error reply is still an answer
+        try:
+            exit_code = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            exit_code = None
+        drain_seconds = time.monotonic() - t_term
+        status_client.close()
+
+        lat_ms = sorted(1e3 * v for v in latencies)
+        pct = (lambda q: round(float(np.percentile(lat_ms, q)), 2)) \
+            if lat_ms else (lambda q: 0.0)
+        emit(many_rps, (many_rps / base_rps) if base_rps else 0.0,
+             backend=probe.get('backend', 'unknown'),
+             device=probe.get('device_kind', 'unknown'),
+             env=env_name, clients=n_clients,
+             requests_per_client=requests,
+             requests_measured=len(lat_ms),
+             single_client_requests_per_sec=round(base_rps, 2),
+             p50_ms=pct(50), p95_ms=pct(95), p99_ms=pct(99),
+             batch_fill=round(fill, 2),
+             shed_total=int(status.get('shed', 0)),
+             client_errors=err1 + err_n,
+             drain_requests=len(rids), drain_answered=drained,
+             drain_unanswered=unanswered,
+             drain_seconds=round(drain_seconds, 2),
+             drain_exit_code=exit_code,
+             vs_baseline_def=('%d-client req/s over single-client req/s '
+                              'against the same service — the continuous-'
+                              'batching concurrency gain' % n_clients),
+             geometry=('headline'
+                       if (n_clients >= 8 and requests >= 32
+                           and env_name == 'HungryGeese') else 'dryrun'))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _last_measured() -> str:
     """The newest on-silicon bench-headline row, summarized for the
     backend-unavailable JSON line — so a wedged tunnel at the driver's
@@ -825,6 +1013,8 @@ def main():
             run_actor(probe)
         elif _active_mode() == 'mesh':
             run_mesh(probe)
+        elif _active_mode() == 'serve':
+            run_serve(probe)
         else:
             run_bench(probe)
     except Exception as exc:  # noqa: BLE001 — the contract is: always emit

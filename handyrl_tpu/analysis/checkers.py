@@ -53,6 +53,12 @@ SCOPE_GL001 = (
     'handyrl_tpu/device_generation.py',
     'handyrl_tpu/agent.py',
     'handyrl_tpu/ops/batch.py',
+    # the serving tier serves the SAME act/sample contract the record
+    # paths replay: a hidden global draw or wall-clock read in a service
+    # reply would fork records between the remote and local paths
+    'handyrl_tpu/serving/registry.py',
+    'handyrl_tpu/serving/service.py',
+    'handyrl_tpu/serving/client.py',
 )
 
 SCOPE_GL002 = (
@@ -66,6 +72,11 @@ SCOPE_GL002 = (
     # step and the mesh staging helpers share the no-host-sync contract
     'handyrl_tpu/parallel/partition.py',
     'handyrl_tpu/parallel/mesh.py',
+    # the serving tier dispatches compiled forwards through the engines it
+    # hosts; any jitted code it grows inherits the no-host-sync contract
+    'handyrl_tpu/serving/registry.py',
+    'handyrl_tpu/serving/service.py',
+    'handyrl_tpu/serving/client.py',
 )
 
 SCOPE_GL003_EXEMPT = (
@@ -78,6 +89,12 @@ SCOPE_GL004 = (
     'handyrl_tpu/inference.py',
     'handyrl_tpu/fault.py',
     'handyrl_tpu/telemetry.py',
+    # the service's pending-request book and handle maps are shared by the
+    # dispatch thread and every engine thread; the registry's manifest
+    # cache by arbitrary resolver threads
+    'handyrl_tpu/serving/registry.py',
+    'handyrl_tpu/serving/service.py',
+    'handyrl_tpu/serving/client.py',
 )
 
 

@@ -121,6 +121,24 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'reprobe_max_delay': 30.0,     # probe backoff ceiling (s)
     },
 
+    # standalone model-serving tier (serving/, docs/serving.md): a
+    # long-lived InferenceService process hosting registry-versioned models
+    # behind the framed INFER protocol, plus the learner's
+    # publish-to-registry hook and the workers' remote-engine endpoint
+    'serving': {
+        'port': 9997,            # service listen port (main.py --serve); 0 = ephemeral (reported on the ready line)
+        'host': '',              # service bind host ('' = all interfaces)
+        'endpoint': '',          # 'host:port' of a remote InferenceService; engine-mode workers dial it instead of the in-Gather engine (same deadlines/retries/circuit-breaker; a dead service degrades to the local path byte-identically)
+        'line': 'default',       # model line used by the learner's publish hook and for resolving bare-integer request ids ('<line>@<mid>')
+        'registry_dir': '',      # ModelRegistry root (registry.json + owned version files); '' = model_dir
+        'publish': False,        # learner: register every numbered checkpoint with the registry as '<line>@<epoch>' (pinning it against keep_checkpoints GC)
+        'auto_promote': True,    # with publish: each published version also becomes the line's champion (one atomic manifest swap); False = candidates only, promote by hand
+        'engines': 1,            # InferenceEngine fleets inside one service process; models partition across them by handle
+        'max_clients': 64,       # admission control: connections past this are refused with an error frame (serve_shed_total) instead of queueing unboundedly
+        'drain_timeout': 30.0,   # graceful-drain deadline (s) on SIGTERM: every accepted request is answered before exit 75 (the PreemptionGuard supervisor contract)
+        'metrics_port': 0,       # service-side Prometheus /metrics port (0 = exporter off)
+    },
+
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
     # + episode-lifecycle distributed tracing. Accepts a bool (legacy
@@ -281,6 +299,26 @@ def validate(args: Dict[str, Any]) -> None:
                 'reprobe_initial_delay', 'reprobe_max_delay'):
         if inf.get(key) is not None:
             assert float(inf[key]) > 0, 'inference.%s must be > 0' % key
+    srv = ta.get('serving') or {}
+    for key in ('port', 'metrics_port'):
+        if srv.get(key) is not None:
+            port = int(srv[key])
+            assert 0 <= port <= 65535, \
+                'serving.%s must be a TCP port (0 = %s)' % (
+                    key, 'ephemeral' if key == 'port' else 'exporter off')
+    assert int(srv.get('engines', 1)) >= 1, \
+        'serving.engines must be >= 1'
+    assert int(srv.get('max_clients', 64)) >= 1, \
+        'serving.max_clients must be >= 1'
+    assert float(srv.get('drain_timeout', 30.0)) > 0, \
+        'serving.drain_timeout must be > 0'
+    assert str(srv.get('line', 'default')).strip(), \
+        'serving.line must be a non-empty model-line name'
+    endpoint = str(srv.get('endpoint') or '')
+    if endpoint:
+        _ep_host, _, ep_port = endpoint.rpartition(':')
+        assert ep_port.isdigit() and 0 < int(ep_port) <= 65535, \
+            "serving.endpoint must look like 'host:port' (got %r)" % endpoint
     par = ta.get('parallel') or {}
     assert int(par.get('model_parallel', 1)) >= 1, \
         'parallel.model_parallel must be >= 1 (1 = no tensor parallelism)'

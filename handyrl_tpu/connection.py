@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import queue
+import select
 import selectors
 import socket
 import struct
@@ -191,6 +192,20 @@ class FramedConnection:
             _NET_RX.inc(len(chunk))
             self._ready.extend(self._parser.feed(chunk))
         return self._decode(self._ready.popleft())
+
+    def poll(self, timeout: float = 0.0) -> bool:
+        """True when a recv() would find data (a complete frame already
+        buffered, or socket bytes ready within ``timeout`` seconds) — the
+        deadline primitive the timeout-bounded clients (EngineClient's
+        remote-service path, ServiceClient) build on, matching the
+        ``mp.Connection.poll`` surface PipeEndpoint exposes."""
+        if self._ready:
+            return True
+        if self.sock is None:
+            return False
+        readable, _, _ = select.select([self.sock], [], [],
+                                       max(0.0, float(timeout)))
+        return bool(readable)
 
     def drain(self) -> List[Any]:
         """Non-blocking read of everything currently available."""

@@ -509,8 +509,30 @@ class ExportedModel:
 
 
 def load_model(model_path: str, env):
-    """Load a model file: .jaxexp exports (self-contained StableHLO) or
-    learner checkpoints (msgpack params + the env's architecture)."""
+    """Load a model spec: .jaxexp exports (self-contained StableHLO),
+    learner checkpoints (msgpack params + the env's architecture), or the
+    serving tier's named models (docs/serving.md):
+
+    * ``serve://host:port/line@selector`` — a proxy onto a running
+      InferenceService: every agent/evaluator inference becomes a framed
+      request against the engine fleet, resolved by name, so eval servers
+      and league matches follow a promote without restarting;
+    * ``registry://root/line@selector`` — the registry-pinned checkpoint
+      loaded locally (CRC-verified), e.g. ``registry://models/default@champion``.
+    """
+    if model_path.startswith('serve://'):
+        from .serving.client import model_from_spec
+        return model_from_spec(model_path)
+    if model_path.startswith('registry://'):
+        from .model import ModelWrapper
+        from .serving.registry import ModelRegistry, parse_spec
+        rest = model_path[len('registry://'):]
+        root, _, spec = rest.rpartition('/')
+        line, selector = parse_spec(spec)
+        snap = ModelRegistry(root or '.').load_snapshot(line, selector)
+        env.reset()
+        example_obs = env.observation(env.players()[0])
+        return ModelWrapper.from_snapshot(snap, example_obs)
     if model_path.endswith('.jaxexp'):
         return ExportedModel(model_path)
     from .model import ModelWrapper
@@ -529,6 +551,23 @@ def _resolve_agent(model_path: str, env):
     return agent
 
 
+def split_model_specs(raw: str) -> List[str]:
+    """Split the CLI's ``MODEL[:OPPONENT]`` argv on ``:`` while keeping
+    URL-style specs whole: ``serve://host:port/line@sel`` and
+    ``registry://root/line@sel`` carry colons of their own (the scheme and
+    the endpoint port), so a naive split would shred them."""
+    out: List[str] = []
+    for part in raw.split(':'):
+        if out and out[-1].endswith(('serve', 'registry')) \
+                and part.startswith('//'):
+            out[-1] += ':' + part          # scheme:// reassembled
+        elif out and '://' in out[-1] and part[:1].isdigit():
+            out[-1] += ':' + part          # the endpoint's port
+        else:
+            out.append(part)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # CLI entry points
 
@@ -539,7 +578,8 @@ def eval_main(args, argv):
     prepare_env(env_args)
     env = make_env(env_args)
 
-    model_paths = argv[0].split(':') if len(argv) >= 1 else ['models/latest.ckpt']
+    model_paths = (split_model_specs(argv[0]) if len(argv) >= 1
+                   else ['models/latest.ckpt'])
     num_games = int(argv[1]) if len(argv) >= 2 else 100
     num_process = int(argv[2]) if len(argv) >= 3 else 1
 

@@ -185,9 +185,21 @@ class EngineClient:
 
     def __init__(self, conn, args: Dict[str, Any], namespace: int = 0):
         inf = dict(args.get('inference') or {})
+        srv = dict(args.get('serving') or {})
         self.conn = conn
         self._args = args
         self.namespace = int(namespace)
+        # remote-service mode (serving.endpoint, docs/serving.md): engine
+        # frames dial a standalone InferenceService over TCP instead of
+        # riding the gather pipe; requests name the model '<line>@<mid>'
+        # against the service registry. Everything else — deadlines,
+        # retries, the circuit breaker, the byte-identical local fallback —
+        # is the same machinery, so a dead service degrades exactly like a
+        # dead in-Gather engine.
+        self.endpoint = str(srv.get('endpoint') or '')
+        self._line = str(srv.get('line', 'default'))
+        self._remote = None            # lazy FramedConnection to the service
+        self._m_dials = telemetry.counter('worker_engine_remote_dials_total')
         self.timeout = max(0.05, float(inf.get('request_timeout', 10.0)))
         self.retries = max(0, int(inf.get('request_retries', 1)))
         self.failover = bool(inf.get('failover', True))
@@ -246,7 +258,12 @@ class EngineClient:
                       self.namespace, rid)
         if engine_path:
             self._pending[rid] = rec
-            self.conn.send((INFER_KIND, {'rid': rid, **rec}))
+            if not self._send_engine(rid, rec):
+                # dead service endpoint: fail over NOW instead of burning
+                # the request deadline on a socket that never opened
+                self._local_box[rid] = self._fail(
+                    rid, rec,
+                    'service endpoint %s unreachable' % self.endpoint)
         else:
             self._local_box[rid] = self._local_reply(rec)
         return rid
@@ -272,29 +289,88 @@ class EngineClient:
                 if attempt + 1 < attempts:
                     # resend under the same rid: if BOTH replies eventually
                     # arrive, the second is absorbed as stale
-                    self.conn.send((INFER_KIND, {'rid': rid, **rec}))
+                    if not self._send_engine(rid, rec):
+                        break                     # dead service: fail now
                 continue
             if reply.get('error'):
                 self._m_errors.inc()
                 err = str(reply['error'])
                 break
             self._settle_ok(rid)
-            return map_structure(_canon, reply)
+            out = map_structure(_canon, reply)
+            if isinstance(out.get('prob'), float):
+                # the remote-service hop (msgpack) degrades np.float32
+                # scalars to python floats; records must keep the dtype or
+                # they pickle to different bytes than the local path's
+                out['prob'] = np.float32(out['prob'])
+            return out
         return self._fail(rid, rec, err)
 
     # -- internals ---------------------------------------------------------
 
-    def _poll(self, timeout: float) -> bool:
-        poll = getattr(self.conn, 'poll', None)
+    def _infer_conn(self):
+        """The connection engine frames ride: the gather pipe, or — with a
+        ``serving.endpoint`` configured — a lazily-dialed TCP link to the
+        standalone InferenceService."""
+        if not self.endpoint:
+            return self.conn
+        if self._remote is None:
+            from .connection import connect_socket_connection
+            host, _, port = self.endpoint.rpartition(':')
+            self._remote = connect_socket_connection(host or 'localhost',
+                                                     int(port))
+            self._m_dials.inc()
+            _LOG.info('worker %d: dialed inference service %s',
+                      self.namespace, self.endpoint)
+        return self._remote
+
+    def _drop_remote(self):
+        if self._remote is not None:
+            try:
+                self._remote.close()
+            except Exception:
+                pass
+            self._remote = None
+
+    def _send_engine(self, rid: int, rec: Dict[str, Any]) -> bool:
+        """Post one request on the engine path. False means the remote
+        service could not be reached (dial or send failure) — the caller
+        fails the request over; the gather-pipe path never fails here (a
+        dead pipe is fatal to the worker, as before)."""
+        body = {'rid': rid, **rec}
+        if not self.endpoint:
+            self.conn.send((INFER_KIND, body))
+            return True
+        # the service resolves models by name against its registry; the
+        # learner's publish hook registers epoch E as '<line>@<E>'
+        body['model'] = '%s@%d' % (self._line, int(rec['mid']))
+        try:
+            self._infer_conn().send((INFER_KIND, body))
+            return True
+        except (OSError, ConnectionError, EOFError, ValueError):
+            self._drop_remote()
+            return False
+
+    def _poll(self, conn, timeout: float) -> bool:
+        poll = getattr(conn, 'poll', None)
         return True if poll is None else poll(timeout)
 
     def _await(self, rid: int, timeout: float) -> Optional[Dict[str, Any]]:
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()
-            if remaining <= 0 or not self._poll(remaining):
+            if remaining <= 0:
                 return None
-            msg = self.conn.recv()
+            try:
+                conn = self._infer_conn()
+                if not self._poll(conn, remaining):
+                    return None
+                msg = conn.recv()
+            except (OSError, ConnectionError, EOFError):
+                if not self.endpoint:
+                    raise          # a dead gather pipe is fatal (unchanged)
+                self._drop_remote()
+                return None        # treated as a timeout: retry/fail over
             if not is_infer(msg):
                 raise ConnectionError(
                     'unexpected %s frame while awaiting an inference reply'
@@ -507,10 +583,12 @@ class InferenceEngine:
         self._current: List[tuple] = []
         self.crashed: Optional[BaseException] = None
         self._fault: Optional[tuple] = None       # (kind, due_at, stall_s)
-        # local tallies mirror the registry so the fill ratio is computable
-        # even with telemetry disabled (the bench/smoke contract reads it)
+        # local tallies mirror the registry so the fill ratio (and the
+        # serving tier's per-service shed accounting) is computable even
+        # with telemetry disabled (the bench/smoke contract reads them)
         self.requests_served = 0
         self.batches_run = 0
+        self.sheds = 0
         self._m_requests = telemetry.counter('engine_requests_total')
         self._m_batches = telemetry.counter('engine_batches_total')
         self._m_rows = telemetry.REGISTRY.histogram(
@@ -613,6 +691,7 @@ class InferenceEngine:
         with self._cv:
             if self.queue_max and len(self._queue) >= self.queue_max:
                 shed = True    # backpressure: bounded queue, visible drop
+                self.sheds += 1
             else:
                 self._queue.append((endpoint, request, time.monotonic()))
                 self._m_depth.set(len(self._queue))
@@ -866,6 +945,7 @@ class EngineSupervisor:
         self._stopping = False
         self._served_total = 0
         self._batches_total = 0
+        self._sheds_total = 0
         self.restarts = 0
         self._m_restarts = {
             reason: telemetry.counter('engine_restarts_total', reason=reason)
@@ -888,6 +968,11 @@ class EngineSupervisor:
     def batches_run(self) -> int:
         engine = self.engine
         return self._batches_total + (engine.batches_run if engine else 0)
+
+    @property
+    def sheds(self) -> int:
+        engine = self.engine
+        return self._sheds_total + (engine.sheds if engine else 0)
 
     def batch_fill_ratio(self) -> float:
         return self.requests_served / max(1, self.batches_run)
@@ -979,6 +1064,7 @@ class EngineSupervisor:
         engine.abandon()
         self._served_total += engine.requests_served
         self._batches_total += engine.batches_run
+        self._sheds_total += engine.sheds
         # fan-out THROUGH THE RAW reply path: the engine's own (tagged)
         # reply function is already suppressed by the generation bump
         stranded = engine.drain_pending()

@@ -1,0 +1,43 @@
+"""Standalone model-serving tier: the InferenceEngine grown into product infra.
+
+Until PR 10, the Sebulba-style engine (inference.py) was born and died
+inside a Gather — nothing outside one training run could reach it. This
+package promotes it into a long-lived service that outlives any single run,
+the MindSpeed-RL-style separation of inference into its own dataflow stage
+with its own lifecycle, versioning, and SLOs:
+
+* :mod:`.registry` — a **versioned ModelRegistry** grown from the
+  ModelVault idea: named model *lines*, each with a pinned "champion" plus
+  rolling candidate versions, atomic promote/rollback built on the
+  CRC-verified checkpoint machinery (utils/fs.py). Registry state is one
+  atomic JSON manifest, so a service restart recovers the exact serving
+  set, and checkpoint-retention GC never collects a pinned version.
+
+* :mod:`.service` — the **InferenceService** process (``main.py --serve``
+  or ``python -m handyrl_tpu.serving``): one or more supervised
+  InferenceEngines behind the existing framed ``INFER_KIND`` protocol over
+  TCP, continuous batching via the engine's coalescing/pad_to_bucket
+  machinery, admission control with shed-on-overload, per-client/per-model
+  request-latency histograms on ``/metrics``, and graceful drain on
+  SIGTERM under the PR 4 PreemptionGuard contract (exit 75 = restart me;
+  every accepted request is answered before exit).
+
+* :mod:`.client` — the client side: :class:`~.client.ServiceClient` speaks
+  the framed protocol to a service endpoint, and
+  :class:`~.client.RemoteServiceModel` presents the model surface the
+  agents/evaluators dispatch on, so ``eval_server``/``eval_client`` and
+  league-style match traffic all resolve models by ``name@version``
+  against one engine fleet (``serve://host:port/name@version`` /
+  ``registry://root/name@version`` model specs in evaluation.load_model).
+
+Worker fleets join the same tier: an :class:`~.inference.EngineClient`
+with ``serving.endpoint`` configured dials the remote service instead of
+the in-Gather engine, keeping its timeout/retry/circuit-breaker failover —
+a dead service degrades to the per-worker path byte-identically.
+"""
+
+from .registry import (ModelRegistry, RegistryError, parse_spec,
+                       pinned_checkpoint_paths)
+
+__all__ = ['ModelRegistry', 'RegistryError', 'parse_spec',
+           'pinned_checkpoint_paths']

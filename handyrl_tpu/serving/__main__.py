@@ -1,0 +1,69 @@
+"""Standalone service runner: ``python -m handyrl_tpu.serving [flags]``.
+
+The ``main.py --serve`` mode serves whatever ``config.yaml`` describes;
+this runner is the harness-friendly flavor (bench.py BENCH_MODE=serve,
+scripts/serve_smoke.py, ad-hoc ops): every knob is a flag, defaults come
+from the same config layer, and the ready line on stdout carries the bound
+ports. Exit code follows the PreemptionGuard contract (75 after a SIGTERM
+drain).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='python -m handyrl_tpu.serving',
+        description='standalone handyrl_tpu inference service '
+                    '(docs/serving.md)')
+    ap.add_argument('--env', default='TicTacToe',
+                    help='environment name (builds the example observation '
+                         'the engines materialize snapshots against)')
+    ap.add_argument('--registry', default='models',
+                    help='model-registry root (serving.registry_dir)')
+    ap.add_argument('--port', type=int, default=0,
+                    help='listen port (0 = ephemeral, reported on the '
+                         'ready line)')
+    ap.add_argument('--host', default='', help='bind host')
+    ap.add_argument('--line', default='default',
+                    help='default model line for bare-integer request ids')
+    ap.add_argument('--engines', type=int, default=1)
+    ap.add_argument('--max-clients', type=int, default=64)
+    ap.add_argument('--drain-timeout', type=float, default=30.0)
+    ap.add_argument('--metrics-port', type=int, default=0,
+                    help='Prometheus /metrics port (0 = exporter off)')
+    ap.add_argument('--wait-ms', type=float, default=None,
+                    help='override inference.batch_wait_ms')
+    ap.add_argument('--max-batch', type=int, default=None,
+                    help='override inference.max_batch')
+    args = ap.parse_args(argv)
+
+    from ..config import apply_defaults
+    from .service import serve_main
+
+    inference = {}
+    if args.wait_ms is not None:
+        inference['batch_wait_ms'] = float(args.wait_ms)
+    if args.max_batch is not None:
+        inference['max_batch'] = int(args.max_batch)
+    cfg = apply_defaults({
+        'env_args': {'env': args.env},
+        'train_args': {
+            'inference': inference,
+            'serving': {
+                'port': args.port, 'host': args.host, 'line': args.line,
+                'registry_dir': args.registry, 'engines': args.engines,
+                'max_clients': args.max_clients,
+                'drain_timeout': args.drain_timeout,
+                'metrics_port': args.metrics_port,
+            },
+        },
+    })
+    serve_main(cfg, [])
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

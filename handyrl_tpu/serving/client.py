@@ -1,0 +1,216 @@
+"""Client side of the serving tier: framed-protocol transport + model proxies.
+
+:class:`ServiceClient` owns one TCP connection to an
+:class:`~.service.InferenceService` and speaks the same framed msgpack
+``INFER_KIND`` protocol the worker<->gather pipes use, plus the
+``SERVE_KIND`` admin frames (status / resolve). :class:`RemoteServiceModel`
+wraps a client + a ``line@selector`` spec into the model surface the
+evaluation agents dispatch on (``inference`` / ``init_hidden`` / ``act``),
+so a match harness resolves models by name against the engine fleet
+instead of holding params.
+
+Reply canonicalization: scalar floats degrade to python floats across the
+msgpack hop (the wire codec converts numpy scalars); ``act`` re-wraps the
+sampled probability as ``np.float32`` so records built from service
+replies stay byte-identical to locally-computed ones (the PR 5 contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..connection import INFER_KIND, connect_socket_connection, is_infer
+
+_LOG = telemetry.get_logger('serving')
+
+# Admin frames on a service connection (status / resolve / drain probes).
+# Rides next to INFER_KIND; the Hub passes both through untyped.
+SERVE_KIND = '__serve__'
+
+
+def is_serve(msg) -> bool:
+    """True for a serving-tier admin frame (request or reply)."""
+    return (isinstance(msg, (list, tuple)) and len(msg) == 2
+            and msg[0] == SERVE_KIND)
+
+
+def parse_endpoint(endpoint: str) -> Tuple[str, int]:
+    """``'host:port'`` -> (host, port); a bare port means localhost."""
+    host, _, port = str(endpoint).rpartition(':')
+    return host or 'localhost', int(port)
+
+
+def canonicalize_reply(reply: Dict[str, Any]) -> Dict[str, Any]:
+    """Restore the scalar dtype the engine computed: the wire codec turns
+    ``np.float32`` scalars into python floats, and a record storing the
+    python float would pickle to different bytes than the local path's."""
+    if isinstance(reply.get('prob'), float):
+        reply['prob'] = np.float32(reply['prob'])
+    return reply
+
+
+class ServiceError(RuntimeError):
+    """The service answered a request with an error reply."""
+
+
+class ServiceClient:
+    """One client connection to an InferenceService endpoint.
+
+    ``submit``/``collect`` split (so simultaneous requests pipeline into
+    one engine batch, like the worker's act_send/act_recv); ``request`` is
+    the one-shot convenience. Thread-safe for one submitter at a time per
+    instance — concurrent load generators should hold one client each.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 name: str = ''):
+        self.conn = connect_socket_connection(host, int(port))
+        self.timeout = float(timeout)
+        self.name = name
+        self._rid = 0
+        self._box: Dict[int, Dict[str, Any]] = {}   # rid -> early reply
+        self._admin: deque = deque()                # out-of-band serve frames
+        self._lock = threading.Lock()
+
+    def close(self):
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, model: str, obs, hidden=None, legal=None,
+               seed=None) -> int:
+        """Post one inference request for ``model`` (a ``line@selector``
+        spec); returns its request id."""
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+        body: Dict[str, Any] = {'rid': rid, 'model': str(model), 'obs': obs}
+        if self.name:
+            body['client'] = self.name
+        if hidden is not None:
+            body['hidden'] = hidden
+        if legal is not None:
+            body['legal'] = [int(a) for a in legal]
+        if seed is not None:
+            body['seed'] = [int(s) for s in seed]
+        self.conn.send((INFER_KIND, body))
+        return rid
+
+    def collect(self, rid: int, timeout: Optional[float] = None
+                ) -> Dict[str, Any]:
+        """The reply for ``rid`` (raises :class:`ServiceError` on an error
+        reply, TimeoutError past the deadline)."""
+        if rid in self._box:
+            reply = self._box.pop(rid)
+        else:
+            reply = self._await(lambda m: (is_infer(m)
+                                           and m[1].get('rid') == rid),
+                                timeout)
+            if reply is None:
+                raise TimeoutError('no service reply for rid %d within '
+                                   '%.1fs' % (rid, timeout or self.timeout))
+            reply = reply[1]
+        if reply.get('error'):
+            raise ServiceError(str(reply['error']))
+        return canonicalize_reply(reply)
+
+    def request(self, model: str, obs, hidden=None, legal=None, seed=None,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.collect(self.submit(model, obs, hidden=hidden,
+                                        legal=legal, seed=seed),
+                            timeout=timeout)
+
+    # -- admin frames ------------------------------------------------------
+
+    def _call_admin(self, body: Dict[str, Any],
+                    timeout: Optional[float] = None) -> Dict[str, Any]:
+        self.conn.send((SERVE_KIND, body))
+        reply = self._await(is_serve, timeout)
+        if reply is None:
+            raise TimeoutError('no %r reply from the service'
+                               % body.get('op'))
+        return reply[1]
+
+    def status(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """The service's live stats: lines/champions, request counters,
+        drain state."""
+        return self._call_admin({'op': 'status'}, timeout)
+
+    def resolve(self, spec: str, timeout: Optional[float] = None
+                ) -> Dict[str, Any]:
+        """Ask the service what ``line@selector`` currently names."""
+        return self._call_admin({'op': 'resolve', 'model': str(spec)},
+                                timeout)
+
+    # -- internals ---------------------------------------------------------
+
+    def _await(self, want, timeout: Optional[float]):
+        """Next frame matching ``want``; early inference replies are boxed,
+        stray admin frames queued. None on deadline."""
+        if want is is_serve and self._admin:
+            return (SERVE_KIND, self._admin.popleft())
+        deadline = time.monotonic() + (self.timeout if timeout is None
+                                       else float(timeout))
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self.conn.poll(remaining):
+                return None
+            msg = self.conn.recv()
+            if want(msg):
+                return msg
+            if is_infer(msg) and isinstance(msg[1], dict):
+                rid = msg[1].get('rid')
+                if rid is not None:
+                    self._box[rid] = msg[1]
+                continue
+            if is_serve(msg) and isinstance(msg[1], dict):
+                self._admin.append(msg[1])
+
+
+class RemoteServiceModel:
+    """Model-surface proxy over a :class:`ServiceClient`: calls become
+    request frames against one ``line@selector`` spec. ``init_hidden``
+    returns None by design — the engine substitutes a fresh initial state
+    for a None hidden, so the client needs no knowledge of the recurrent
+    state's structure (same contract as the in-Gather RemoteModel)."""
+
+    def __init__(self, client: ServiceClient, model: str):
+        self.client = client
+        self.model = str(model)
+
+    def init_hidden(self, batch_shape=None):
+        return None
+
+    def inference(self, obs, hidden=None) -> Dict[str, Any]:
+        return self.client.request(self.model, obs, hidden=hidden)['outputs']
+
+    def act(self, obs, hidden, legal_actions, seed_seq) -> Dict[str, Any]:
+        return self.client.request(self.model, obs, hidden=hidden,
+                                   legal=legal_actions, seed=seed_seq)
+
+    def close(self):
+        self.client.close()
+
+
+def model_from_spec(spec: str, timeout: float = 10.0) -> RemoteServiceModel:
+    """``'serve://host:port/line@selector'`` -> a connected proxy model
+    (owning its client connection)."""
+    rest = str(spec)
+    if rest.startswith('serve://'):
+        rest = rest[len('serve://'):]
+    endpoint, _, model = rest.partition('/')
+    if not model:
+        raise ValueError('serve:// spec %r carries no line@selector path'
+                         % spec)
+    host, port = parse_endpoint(endpoint)
+    return RemoteServiceModel(ServiceClient(host, port, timeout=timeout),
+                              model)

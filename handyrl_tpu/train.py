@@ -1206,6 +1206,7 @@ class Learner:
                                    str(exc)[:120])
                         telemetry.counter('guard_ckpt_fallbacks_total').inc()
         self._trainer_thread: Optional[threading.Thread] = None
+        self._registry = None   # lazy ModelRegistry (serving.publish)
 
         # the scrape endpoint binds only once everything it reads (trainer,
         # worker front-end) exists — a scrape can land any time after this
@@ -1279,7 +1280,42 @@ class Learner:
         if state_blob is not None:
             checksummed_write_bytes(self.trainer_state_path(), state_blob)
             write_layout_manifest(self.trainer_state_path(), layout)
+        # publish BEFORE retention GC: a version the registry is about to
+        # pin must be pinned by the time the GC pass reads the manifest
+        self._publish_checkpoint(steps)
         self._gc_checkpoints()
+
+    def _registry_root(self) -> str:
+        srv = self.args.get('serving') or {}
+        return srv.get('registry_dir') or self.args.get('model_dir', 'models')
+
+    def _publish_checkpoint(self, steps: int):
+        """``serving.publish``: register the just-written numbered
+        checkpoint with the ModelRegistry as ``<line>@<epoch>`` (pinning it
+        against ``keep_checkpoints`` GC); ``serving.auto_promote`` also
+        makes it the line's champion in the same atomic manifest swap. A
+        registry failure is loud but never takes training down."""
+        srv = self.args.get('serving') or {}
+        if not srv.get('publish'):
+            return
+        if self._registry is None:
+            from .serving.registry import ModelRegistry
+            self._registry = ModelRegistry(self._registry_root())
+        try:
+            from . import models as model_zoo
+            from .model import module_config
+            self._registry.publish(
+                str(srv.get('line', 'default')),
+                path=self.model_path(self.model_epoch),
+                architecture=model_zoo.architecture_name(self.wrapper.module),
+                config=module_config(self.wrapper.module) or None,
+                steps=int(steps), version=self.model_epoch,
+                promote=bool(srv.get('auto_promote', True)))
+        except Exception as exc:
+            _LOG.error('registry publish of epoch %d failed (%s: %s); '
+                       'training continues unpublished', self.model_epoch,
+                       type(exc).__name__, str(exc)[:200])
+            telemetry.counter('registry_publish_failures_total').inc()
 
     # -- checkpoint integrity / retention / rollback -----------------------
     def _load_resume_params(self):
@@ -1401,8 +1437,12 @@ class Learner:
     def _gc_checkpoints(self):
         """``keep_checkpoints: N`` retention: drop numbered ckpts beyond
         the newest N (plus their sidecars). League-opponent checkpoint
-        paths are never deleted; the rollback target (the newest valid
-        epoch) is always inside the kept window."""
+        paths and registry-pinned versions (the serving tier's champion or
+        any live candidate — serving/registry.py) are never deleted; the
+        rollback target (the newest valid epoch) is always inside the kept
+        window. An unreadable registry manifest SUSPENDS the GC pass: with
+        the pin set unknown, deleting anything could pull a champion out
+        from under a live service."""
         keep = int(self.args.get('keep_checkpoints') or 0)
         if keep <= 0:
             return
@@ -1411,13 +1451,21 @@ class Learner:
         epochs = guard_mod.numbered_checkpoints(model_dir)
         if len(epochs) <= keep:
             return
+        from .serving.registry import pinned_checkpoint_paths
+        pinned = pinned_checkpoint_paths(self._registry_root())
+        if pinned is None:
+            return   # corrupt manifest: conservatively collect nothing
         protected = {os.path.abspath(o)
                      for o in (self.args.get('eval', {}).get('opponent') or [])
                      if isinstance(o, str) and os.path.exists(o)}
         for epoch in epochs[:-keep]:
             path = self.model_path(epoch)
-            if os.path.abspath(path) in protected:
-                continue
+            apath = os.path.abspath(path)
+            if apath in pinned:
+                telemetry.counter('guard_ckpt_gc_pinned_total').inc()
+                continue   # registry-pinned: serving depends on these bytes
+            if apath in protected:
+                continue   # checkpoint league opponent
             for p in (path, sidecar_path(path), layout_path(path)):
                 try:
                     os.unlink(p)

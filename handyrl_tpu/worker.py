@@ -320,7 +320,15 @@ class Gather:
         self._rpc_lock = threading.RLock()
 
         self.engine: Optional[EngineSupervisor] = None
-        if (args.get('inference') or {}).get('enabled'):
+        remote_endpoint = (args.get('serving') or {}).get('endpoint')
+        if (args.get('inference') or {}).get('enabled') and remote_endpoint:
+            # remote-service mode (docs/serving.md): workers dial the
+            # standalone InferenceService directly (EngineClient owns the
+            # link + failover), so this relay spawns no engine of its own —
+            # the 'model' RPC path stays available for degraded workers
+            _LOG.info('gather %d: inference routed to remote service %s; '
+                      'no local engine', gather_id, remote_endpoint)
+        elif (args.get('inference') or {}).get('enabled'):
             # per-host batched inference service: this relay alone pulls
             # model snapshots; its workers submit (mid, obs, hidden, legal)
             # frames and receive sampled actions back over the same pipes.

@@ -14,6 +14,8 @@ modes:
   --eval, -e           evaluate MODEL_PATH[:OPPONENT] [NUM_GAMES [NUM_PROC]]
   --eval-server, -es   network battle server [NUM_GAMES [NUM_PROC]]
   --eval-client, -ec   network battle client MODEL_PATH [HOST]
+  --serve, -sv         standalone model-serving tier (registry-versioned
+                       inference service; SIGTERM drains and exits 75)
 """
 
 
@@ -56,6 +58,9 @@ def main():
     elif mode in ('--eval-client', '-ec'):
         from handyrl_tpu.evaluation import eval_client_main
         eval_client_main(args, rest)
+    elif mode in ('--serve', '-sv'):
+        from handyrl_tpu.serving.service import serve_main
+        serve_main(args, rest)
     else:
         print('Not found mode %s.' % mode)
         print(USAGE)

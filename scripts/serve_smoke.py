@@ -1,0 +1,68 @@
+"""CI smoke for the standalone model-serving tier (docs/serving.md).
+
+Runs ``bench.py`` in BENCH_MODE=serve on a tiny CPU geometry (TicTacToe,
+4 clients) — a REAL InferenceService subprocess with a registry-resolved
+model behind the framed INFER protocol — and asserts the service contract
+rather than a throughput number (CI machines are too noisy for thresholds):
+
+  * the run completes and honors the one-JSON-line stdout contract;
+  * the engines actually batch across clients (batch-fill > 1) and nothing
+    is shed at this load (shed_total == 0) with zero client errors;
+  * the graceful-drain contract holds: every request in flight through the
+    SIGTERM is answered (drain_unanswered == 0) and the service exits 75
+    (EX_TEMPFAIL — the PreemptionGuard supervisor contract).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.update({
+        'JAX_PLATFORMS': 'cpu',
+        'BENCH_MODE': 'serve',
+        'BENCH_SERVE_ENV': 'TicTacToe',
+        'BENCH_SERVE_CLIENTS': '4',
+        'BENCH_SERVE_REQUESTS': '10',
+        'BENCH_SERVE_WARMUP': '2',
+        'BENCH_SERVE_DRAIN': '2',
+        # generous coalescing window: the smoke asserts batching works, not
+        # that it is fast, and CI boxes schedule client threads erratically
+        'BENCH_SERVE_WAIT_MS': '20',
+        'BENCH_DEADLINE_SEC': env.get('BENCH_DEADLINE_SEC', '540'),
+    })
+    proc = subprocess.run([sys.executable, os.path.join(REPO, 'bench.py')],
+                          env=env, stdout=subprocess.PIPE, text=True,
+                          timeout=600)
+    out = proc.stdout.strip().splitlines()
+    assert len(out) == 1, 'one-JSON-line contract violated: %r' % (out,)
+    row = json.loads(out[0])
+    print(json.dumps(row, indent=2))
+    assert 'error' not in row, row.get('error')
+    assert row['value'] > 0, 'service produced no measured requests'
+    assert row['client_errors'] == 0, row
+    assert row['shed_total'] == 0, \
+        'requests shed at the smoke load (shed_total %d)' % row['shed_total']
+    assert row['batch_fill'] > 1.0, \
+        'service never batched past 1 request/forward (fill %.2f)' \
+        % row['batch_fill']
+    assert row['drain_unanswered'] == 0, \
+        '%d request(s) dropped un-answered through the SIGTERM drain' \
+        % row['drain_unanswered']
+    assert row['drain_exit_code'] == 75, \
+        'service exited %s, not the supervisor-contract 75' \
+        % row['drain_exit_code']
+    print('serve smoke OK: %.1f req/s at %d clients (fill %.2f), '
+          'drain %d/%d answered, exit 75'
+          % (row['value'], row['clients'], row['batch_fill'],
+             row['drain_answered'], row['drain_requests']))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -69,6 +69,7 @@ _POSIX_ONLY_FILES = (
     'test_cli.py', 'test_eval_cli.py', 'test_multihost.py',
     'test_batcher_processes.py', 'test_stress.py',
     'test_fault_tolerance.py', 'test_guard.py', 'test_engine_failover.py',
+    'test_serving.py',
 )
 
 

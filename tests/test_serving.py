@@ -1,0 +1,577 @@
+"""Serving-tier tests: the versioned ModelRegistry (atomic promote/rollback
+under races, restart recovery, CRC-verified loads, GC pinning), the
+InferenceService end to end (continuous batching, name@version resolution,
+promote mid-traffic, admission/drain), the EngineClient remote-service
+path with its byte-identical failover, and the serve:///registry:// model
+specs the evaluation stack resolves."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.generation import model_act, sample_seed
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.serving.client import (RemoteServiceModel, ServiceClient,
+                                        ServiceError, model_from_spec)
+from handyrl_tpu.serving.registry import (ModelRegistry, RegistryError,
+                                          parse_spec,
+                                          pinned_checkpoint_paths)
+from handyrl_tpu.serving.service import InferenceService
+from handyrl_tpu.utils.fs import checksummed_write_bytes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ttt_wrapper(seed=7):
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    w = ModelWrapper(env.net(), seed=seed)
+    w.ensure_params(env.observation(0))
+    return env, w
+
+
+def _service_args(root, **srv):
+    args = apply_defaults({
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'serving': {'port': 0, 'registry_dir': root, **srv}},
+    })['train_args']
+    args['env'] = {'env': 'TicTacToe'}
+    return args
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_split_model_specs_keeps_url_specs_whole():
+    from handyrl_tpu.evaluation import split_model_specs
+    assert split_model_specs('models/latest.ckpt') == ['models/latest.ckpt']
+    assert split_model_specs('a.ckpt:random') == ['a.ckpt', 'random']
+    assert split_model_specs('serve://h:9997/l@champion') == \
+        ['serve://h:9997/l@champion']
+    assert split_model_specs('serve://h:9997/l@champion:random') == \
+        ['serve://h:9997/l@champion', 'random']
+    assert split_model_specs('registry://models/l@3:rulebase') == \
+        ['registry://models/l@3', 'rulebase']
+    assert split_model_specs('a.ckpt:serve://h:1/l@latest') == \
+        ['a.ckpt', 'serve://h:1/l@latest']
+
+
+def test_parse_spec():
+    assert parse_spec('line@champion') == ('line', 'champion')
+    assert parse_spec('line@7') == ('line', '7')
+    assert parse_spec('line') == ('line', 'champion')
+    assert parse_spec('line@') == ('line', 'champion')
+    with pytest.raises(RegistryError):
+        parse_spec('@champion')
+
+
+def test_registry_publish_resolve_load(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    v1 = reg.publish('default', snapshot={'architecture': 'X',
+                                          'params': b'AAAA'}, steps=10)
+    v2 = reg.publish('default', snapshot={'architecture': 'X',
+                                          'params': b'BBBB'}, steps=20)
+    # first publish auto-champions; later ones are candidates
+    assert reg.resolve('default', 'champion')[0] == v1
+    assert reg.resolve('default', 'latest')[0] == v2
+    assert reg.resolve('default', v2)[1]['steps'] == 20
+    snap = reg.load_snapshot('default', v2)
+    assert snap['params'] == b'BBBB'
+    assert snap['architecture'] == 'X' and snap['version'] == v2
+    with pytest.raises(RegistryError):
+        reg.resolve('default', '99')
+    with pytest.raises(RegistryError):
+        reg.resolve('nosuchline')
+    # restart recovery: a fresh instance reads the exact serving set
+    again = ModelRegistry(str(tmp_path))
+    assert again.resolve('default', 'champion')[0] == v1
+    assert sorted(again.describe()['default']['versions']) == sorted([v1, v2])
+
+
+def test_registry_promote_rollback_bit_identical(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('line', snapshot={'architecture': 'X', 'params': b'OLD1'},
+                version=1, promote=True)
+    reg.publish('line', snapshot={'architecture': 'X', 'params': b'NEW2'},
+                version=2)
+    before = reg.load_snapshot('line', 'champion')['params']
+    reg.promote('line', 2)
+    assert reg.load_snapshot('line', 'champion')['params'] == b'NEW2'
+    restored = reg.rollback('line')
+    assert restored == '1'
+    # the prior champion returns bit-identically (its bytes never moved)
+    assert reg.load_snapshot('line', 'champion')['params'] == before == b'OLD1'
+    # rollback is itself revertible (champion/previous swap)
+    assert reg.rollback('line') == '2'
+
+
+def test_registry_publish_by_path_and_retire(tmp_path):
+    ckpt = str(tmp_path / 'ext' / '5.ckpt')
+    os.makedirs(os.path.dirname(ckpt))
+    checksummed_write_bytes(ckpt, b'EXTERNAL')
+    reg = ModelRegistry(str(tmp_path / 'reg'))
+    with pytest.raises(RegistryError):
+        reg.publish('l', path=ckpt)          # architecture required
+    reg.publish('l', path=ckpt, architecture='X', version=5, promote=True)
+    assert reg.load_snapshot('l')['params'] == b'EXTERNAL'
+    assert ckpt in {os.path.abspath(p) for p in reg.pinned_paths()}
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'C'},
+                version=6)
+    with pytest.raises(RegistryError):
+        reg.retire('l', 5)                   # champion cannot be retired
+    reg.retire('l', 6)                       # candidate can
+    assert '6' not in reg.describe()['l']['versions']
+    with pytest.raises(RegistryError):
+        reg.publish('l', snapshot={'architecture': 'X', 'params': b'D'},
+                    version=5)               # duplicate version id
+
+
+def test_registry_corrupt_version_is_unloadable(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'GOODBYTES'},
+                version=1, promote=True)
+    path = reg.resolve('l', '1')[1]['path']
+    raw = bytearray(open(path, 'rb').read())
+    raw[0] ^= 0xFF
+    with open(path, 'wb') as f:              # deliberate torn write
+        f.write(bytes(raw))
+    # resolution still answers (the manifest is intact)...
+    assert reg.resolve('l', 'champion')[0] == '1'
+    # ...but the load refuses the unverifiable bytes
+    with pytest.raises(RegistryError, match='unverifiable'):
+        reg.load_snapshot('l', 'champion')
+
+
+def test_registry_corrupt_manifest_suspends_pinning(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'A'},
+                version=1, promote=True)
+    assert pinned_checkpoint_paths(str(tmp_path))
+    with open(os.path.join(str(tmp_path), 'registry.json'), 'w') as f:
+        f.write('{torn json')
+    # present-but-unreadable manifest => pin set UNKNOWN, not empty
+    assert pinned_checkpoint_paths(str(tmp_path)) is None
+    # and no manifest at all => genuinely nothing pinned
+    assert pinned_checkpoint_paths(str(tmp_path / 'nowhere')) == set()
+
+
+@pytest.mark.timeout(120)
+def test_registry_racing_promotes_never_torn(tmp_path):
+    """Two promote racers (one in-process thread, one separate PROCESS) +
+    a reader: every mid-race read observes a complete, CRC-valid serving
+    set — champion always one of the two versions, bytes always loadable."""
+    root = str(tmp_path)
+    reg = ModelRegistry(root)
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'AAAA'},
+                version=1, promote=True)
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'BBBB'},
+                version=2)
+
+    errs = []
+
+    def thread_racer():
+        try:
+            r = ModelRegistry(root)
+            for k in range(60):
+                r.promote('l', 1 + (k % 2))
+        except Exception as exc:   # noqa: BLE001 — surfaced via errs
+            errs.append(exc)
+
+    child = subprocess.Popen(
+        [sys.executable, '-c',
+         'import sys; sys.path.insert(0, %r)\n'
+         'from handyrl_tpu.serving.registry import ModelRegistry\n'
+         'r = ModelRegistry(%r)\n'
+         'for k in range(60): r.promote("l", 2 - (k %% 2))\n'
+         % (REPO, root)],
+        stderr=subprocess.DEVNULL,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    racer = threading.Thread(target=thread_racer, name='promote-racer')
+    racer.start()
+    reads = 0
+    while racer.is_alive() or child.poll() is None:
+        snap = ModelRegistry(root).load_snapshot('l', 'champion')
+        assert snap['params'] in (b'AAAA', b'BBBB')
+        assert snap['version'] in ('1', '2')
+        reads += 1
+    racer.join()
+    assert child.wait() == 0
+    assert not errs, errs
+    assert reads > 0
+    # the final state is one of the two promotes, fully consistent
+    final = ModelRegistry(root)
+    champ = final.resolve('l', 'champion')[0]
+    assert champ in ('1', '2')
+    assert final.load_snapshot('l')['params'] == \
+        {'1': b'AAAA', '2': b'BBBB'}[champ]
+
+
+# ---------------------------------------------------------------------------
+# keep_checkpoints GC × registry pins (the PR 4 exclusion, extended)
+
+
+class _GcLearnerStub:
+    """The REAL Learner retention-GC code over a synthetic model_dir (the
+    method needs only args + model_path)."""
+
+    def __init__(self, args):
+        from handyrl_tpu.train import Learner
+        self.args = args
+        self.model_path = Learner.model_path.__get__(self)
+        self._gc_checkpoints = Learner._gc_checkpoints.__get__(self)
+        self._registry_root = Learner._registry_root.__get__(self)
+
+
+def _fake_ckpts(model_dir, epochs):
+    os.makedirs(model_dir, exist_ok=True)
+    for e in epochs:
+        checksummed_write_bytes(os.path.join(model_dir, '%d.ckpt' % e),
+                                b'ckpt-%d' % e)
+
+
+def test_gc_never_collects_registry_pinned(tmp_path):
+    model_dir = str(tmp_path / 'models')
+    _fake_ckpts(model_dir, [1, 2, 3, 4, 5])
+    # the registry pins epoch 2 (a champion) and epoch 3 (a candidate)
+    reg = ModelRegistry(model_dir)
+    reg.publish('default', path=os.path.join(model_dir, '2.ckpt'),
+                architecture='X', version=2, promote=True)
+    reg.publish('default', path=os.path.join(model_dir, '3.ckpt'),
+                architecture='X', version=3)
+    stub = _GcLearnerStub({'keep_checkpoints': 2, 'model_dir': model_dir,
+                           'eval': {}, 'serving': {}})
+    stub._gc_checkpoints()
+    left = sorted(int(n.split('.')[0]) for n in os.listdir(model_dir)
+                  if n.endswith('.ckpt') and n.split('.')[0].isdigit())
+    # epochs 4,5 kept by the window; 2,3 kept by the PIN; only 1 collected
+    assert left == [2, 3, 4, 5]
+    # retiring the candidate unpins it: the next pass collects epoch 3
+    reg.retire('default', 3)
+    stub._gc_checkpoints()
+    left = sorted(int(n.split('.')[0]) for n in os.listdir(model_dir)
+                  if n.endswith('.ckpt') and n.split('.')[0].isdigit())
+    assert left == [2, 4, 5]
+
+
+def test_learner_publish_hook_pins_and_promotes(tmp_path):
+    """The REAL Learner publish hook (serving.publish): each numbered
+    checkpoint lands in the registry as <line>@<epoch>, auto_promote flips
+    the champion, and the pin immediately protects it from the same
+    update's retention GC."""
+    from handyrl_tpu.train import Learner
+    env, w = _ttt_wrapper(seed=7)
+    model_dir = str(tmp_path / 'models')
+    stub = _GcLearnerStub({'keep_checkpoints': 1, 'model_dir': model_dir,
+                           'eval': {},
+                           'serving': {'publish': True, 'line': 'ttt',
+                                       'auto_promote': True}})
+    stub.wrapper = w
+    stub._registry = None
+    stub._publish_checkpoint = Learner._publish_checkpoint.__get__(stub)
+    os.makedirs(model_dir)
+    for epoch in (1, 2, 3):
+        checksummed_write_bytes(os.path.join(model_dir, '%d.ckpt' % epoch),
+                                w.params_bytes())
+        stub.model_epoch = epoch
+        stub._publish_checkpoint(steps=epoch * 10)
+        stub._gc_checkpoints()
+    reg = ModelRegistry(model_dir)
+    assert reg.resolve('ttt', 'champion')[0] == '3'
+    assert reg.resolve('ttt', '3')[1]['steps'] == 30
+    # every published epoch is pinned: GC (keep=1) collected NOTHING
+    left = sorted(int(n.split('.')[0]) for n in os.listdir(model_dir)
+                  if n.endswith('.ckpt') and n.split('.')[0].isdigit())
+    assert left == [1, 2, 3]
+    # the published bytes load back CRC-verified and bit-identical
+    assert reg.load_snapshot('ttt')['params'] == w.params_bytes()
+    # a remote worker's '<line>@<mid>' convention resolves epochs directly
+    assert reg.resolve('ttt', '2')[0] == '2'
+
+
+def test_gc_suspended_when_manifest_unreadable(tmp_path):
+    model_dir = str(tmp_path / 'models')
+    _fake_ckpts(model_dir, [1, 2, 3, 4])
+    with open(os.path.join(model_dir, 'registry.json'), 'w') as f:
+        f.write('{torn')
+    stub = _GcLearnerStub({'keep_checkpoints': 1, 'model_dir': model_dir,
+                           'eval': {}, 'serving': {}})
+    stub._gc_checkpoints()
+    left = [n for n in os.listdir(model_dir) if n.endswith('.ckpt')]
+    # pin set unknown => conservatively collect NOTHING
+    assert len(left) == 4
+
+
+# ---------------------------------------------------------------------------
+# the service end to end (in-process)
+
+
+@pytest.mark.timeout(300)
+def test_service_end_to_end_promote_and_drain(tmp_path):
+    env, w1 = _ttt_wrapper(seed=7)
+    _, w2 = _ttt_wrapper(seed=8)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w1.snapshot(), version=1, steps=10,
+                promote=True)
+
+    svc = InferenceService(_service_args(str(tmp_path))).start()
+    try:
+        client = ServiceClient('localhost', svc.port, name='t0')
+        seed = sample_seed(11, (0, 3), 0)
+
+        # act parity: the service reply equals the local path bit for bit
+        rep = client.request('default@champion', obs, legal=legal, seed=seed)
+        ref = model_act(w1, obs, None, legal, seed)
+        assert rep['action'] == ref['action']
+        assert rep['prob'] == ref['prob']
+        assert isinstance(rep['prob'], np.float32)
+        np.testing.assert_array_equal(rep['action_mask'], ref['action_mask'])
+        np.testing.assert_array_equal(rep['value'], ref['value'])
+
+        # outputs path (observer plies / Agent.inference): the engine runs
+        # the padded-bucket batched program, so the bit-exact reference is
+        # bucketed_inference, not the (last-float-bit-different) B=1 one
+        from handyrl_tpu.generation import bucketed_inference
+        out = RemoteServiceModel(client, 'default@1').inference(obs)
+        np.testing.assert_array_equal(
+            out['policy'], np.asarray(bucketed_inference(w1, obs)['policy']))
+
+        # bare integer ids resolve as versions of the default line
+        rep_mid = client.collect(client.submit('default@1', obs, legal=legal,
+                                               seed=seed))
+        assert rep_mid['action'] == ref['action']
+
+        # unknown specs are error-ANSWERED, not dropped
+        with pytest.raises(ServiceError):
+            client.request('default@99', obs, legal=legal, seed=seed)
+        with pytest.raises(ServiceError):
+            client.request('nosuchline@champion', obs, legal=legal,
+                           seed=seed)
+
+        # promote mid-traffic: champion flips atomically, zero failed
+        # requests on either side of the flip
+        reg.publish('default', snapshot=w2.snapshot(), version=2, steps=20,
+                    promote=True)
+        rep2 = client.request('default@champion', obs, legal=legal,
+                              seed=seed)
+        ref2 = model_act(w2, obs, None, legal, seed)
+        assert rep2['prob'] == ref2['prob']
+        assert client.resolve('default@champion')['version'] == '2'
+        # rollback restores the prior champion bit-identically
+        reg.rollback('default')
+        rep3 = client.request('default@champion', obs, legal=legal,
+                              seed=seed)
+        assert rep3['prob'] == rep['prob'] and rep3['action'] == rep['action']
+
+        status = client.status()
+        assert status['answered'] == status['received'] > 0
+        assert status['inflight'] == 0 and status['shed'] == 0
+        assert status['lines']['default']['champion'] == '1'
+
+        # drain: new arrivals are error-answered, never silently dropped
+        svc.request_drain()
+        with pytest.raises(ServiceError, match='draining'):
+            client.request('default@champion', obs, legal=legal, seed=seed)
+        assert svc.drained()
+        client.close()
+    finally:
+        svc.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_eval_specs_resolve_against_registry_and_service(tmp_path):
+    """The evaluation stack's model specs: ``registry://`` loads pinned
+    bytes locally; ``serve://`` proxies matches through the service — and
+    an exec_match completes against both, before AND after a promote."""
+    from handyrl_tpu.agent import Agent, RandomAgent
+    from handyrl_tpu.evaluation import exec_match, load_model
+
+    env, w1 = _ttt_wrapper(seed=7)
+    _, w2 = _ttt_wrapper(seed=8)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w1.snapshot(), version=1, promote=True)
+
+    local = load_model('registry://%s/default@champion' % tmp_path, env)
+    from flax import serialization
+    assert serialization.to_bytes(local.params) == w1.snapshot()['params']
+
+    svc = InferenceService(_service_args(str(tmp_path))).start()
+    try:
+        spec = 'serve://localhost:%d/default@champion' % svc.port
+        remote = load_model(spec, env)
+        assert isinstance(remote, RemoteServiceModel)
+        result = exec_match(make_env({'env': 'TicTacToe'}),
+                            {0: Agent(remote), 1: RandomAgent()})
+        assert result is not None and 0 in result['result']
+        # promote mid-league: the SAME proxy follows the champion flip
+        reg.publish('default', snapshot=w2.snapshot(), version=2,
+                    promote=True)
+        result2 = exec_match(make_env({'env': 'TicTacToe'}),
+                             {0: Agent(remote), 1: RandomAgent()})
+        assert result2 is not None
+        remote.close()
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# EngineClient remote-service mode (the serving.endpoint satellite)
+
+
+class _GatherPipeStub:
+    """The worker's gather pipe in remote mode: engine frames must NEVER
+    ride it; the degraded path's 'model' RPC answers with the snapshot."""
+
+    def __init__(self, snapshot):
+        self._snapshot = snapshot
+        self._last = None
+
+    def send(self, msg):
+        assert msg[0] != '__infer__', \
+            'engine frame on the gather pipe in remote-service mode'
+        self._last = msg
+
+    def recv(self):
+        assert self._last[0] == 'model'
+        return self._snapshot
+
+    def poll(self, timeout=0.0):
+        return False
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(('', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _remote_client(endpoint, snapshot, **inf):
+    from handyrl_tpu.inference import EngineClient
+    args = {'inference': {'enabled': True, 'request_timeout': 10.0,
+                          'request_retries': 0, 'failover': True,
+                          'reprobe_initial_delay': 0.1,
+                          'reprobe_max_delay': 0.5, **inf},
+            'serving': {'endpoint': endpoint, 'line': 'default'},
+            'env': {'env': 'TicTacToe'}, 'seed': 11}
+    return EngineClient(_GatherPipeStub(snapshot), args, namespace=9)
+
+
+@pytest.mark.timeout(300)
+def test_engine_client_remote_service_bitwise(tmp_path):
+    from handyrl_tpu.inference import RemoteModel
+    env, w = _ttt_wrapper(seed=7)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w.snapshot(), version=5, promote=True)
+    svc = InferenceService(_service_args(str(tmp_path))).start()
+    try:
+        remote = RemoteModel(
+            _remote_client('localhost:%d' % svc.port, w.snapshot()), 5)
+        for draw in range(4):
+            seed = sample_seed(11, (0, 4), draw)
+            rep = remote.act(obs, None, legal, seed)
+            ref = model_act(w, obs, None, legal, seed)
+            assert rep['action'] == ref['action']
+            assert rep['prob'] == ref['prob']
+            assert isinstance(rep['prob'], np.float32)
+            np.testing.assert_array_equal(rep['value'], ref['value'])
+        assert remote.client.engine_ok
+    finally:
+        svc.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_engine_client_dead_service_fails_over_and_repromotes(tmp_path):
+    """A dead service endpoint degrades to the per-worker path (records
+    byte-identical, circuit open); once a service appears on the endpoint
+    a half-open probe re-promotes the client to the remote path."""
+    from handyrl_tpu.inference import RemoteModel
+    env, w = _ttt_wrapper(seed=7)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    port = _free_port()
+    remote = RemoteModel(_remote_client('localhost:%d' % port,
+                                        w.snapshot()), 5)
+    seed = sample_seed(11, (0, 6), 0)
+    ref = model_act(w, obs, None, legal, seed)
+
+    rep = remote.act(obs, None, legal, seed)          # dead endpoint
+    assert rep['action'] == ref['action'] and rep['prob'] == ref['prob']
+    np.testing.assert_array_equal(rep['action_mask'], ref['action_mask'])
+    assert remote.client.engine_ok is False
+
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w.snapshot(), version=5, promote=True)
+    svc = InferenceService(
+        _service_args(str(tmp_path), port=port)).start()
+    try:
+        deadline = time.monotonic() + 30
+        while not remote.client.engine_ok and time.monotonic() < deadline:
+            time.sleep(0.15)   # let the reprobe backoff elapse
+            rep = remote.act(obs, None, legal, seed)
+            assert rep['prob'] == ref['prob']         # identical either path
+        assert remote.client.engine_ok, 'probe never re-promoted the client'
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# admission + drain e2e (subprocess, SIGTERM)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_service_sigterm_drains_and_exits_75(tmp_path):
+    env, w = _ttt_wrapper(seed=7)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'handyrl_tpu.serving', '--env', 'TicTacToe',
+         '--registry', str(tmp_path), '--port', '0', '--line', 'default'],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    try:
+        ready = json.loads(proc.stdout.readline())['serving_ready']
+        client = ServiceClient('localhost', int(ready['port']), name='drain')
+        # one served request proves the service is live
+        client.request('default@champion', obs, legal=legal,
+                       seed=sample_seed(1, (0, 0), 0))
+        # a burst left in flight through the SIGTERM: every rid must be
+        # ANSWERED (ok or an explicit drain error) before the exit
+        rids = [client.submit('default@champion', obs, legal=legal,
+                              seed=sample_seed(1, (0, k), 0))
+                for k in range(8)]
+        proc.send_signal(signal.SIGTERM)
+        unanswered = 0
+        for rid in rids:
+            try:
+                client.collect(rid, timeout=30)
+            except ServiceError:
+                pass               # drain error reply: answered
+            except TimeoutError:
+                unanswered += 1
+        assert unanswered == 0, '%d request(s) dropped un-answered' \
+            % unanswered
+        assert proc.wait(timeout=60) == 75   # EX_TEMPFAIL: restart me
+        client.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
