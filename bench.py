@@ -788,11 +788,12 @@ def run_mesh(probe: dict):
 
 
 def _serve_client_load(host, port, model, obs, legal, n_clients, warmup,
-                       requests, base_seed):
+                       requests, base_seed, client_factory=None):
     """Drive ``n_clients`` concurrent ServiceClients (one thread each) at
     the service: per-client warmup then ``requests`` timed sequential round
     trips. Returns (requests/sec over the timed span, latency list,
-    error count)."""
+    error count). ``client_factory(ci)`` swaps the client class (the fleet
+    phase routes through RoutedClient against a resolver port)."""
     import threading
     from handyrl_tpu.generation import sample_seed
     from handyrl_tpu.serving.client import ServiceClient
@@ -803,7 +804,10 @@ def _serve_client_load(host, port, model, obs, legal, n_clients, warmup,
     barrier = threading.Barrier(n_clients)
 
     def run(ci):
-        client = ServiceClient(host, port, timeout=60.0, name='c%d' % ci)
+        if client_factory is not None:
+            client = client_factory(ci)
+        else:
+            client = ServiceClient(host, port, timeout=60.0, name='c%d' % ci)
         mine = []
         try:
             for k in range(warmup):
@@ -840,6 +844,111 @@ def _serve_client_load(host, port, model, obs, legal, n_clients, warmup,
     return len(latencies) / max(span, 1e-9), latencies, errors[0]
 
 
+def _serve_fleet_phase(env_name, wrapper, obs, legal, n_clients, requests,
+                       warmup, wait_ms, single_rps):
+    """The BENCH_MODE=serve fleet phase: a resolver + BENCH_SERVE_REPLICAS
+    managed replicas under the same client load, routed through
+    RoutedClient. Returns the extra emit keys (fleet_* scaling vs the
+    single-service row, rolling-promote p99 before/during, resolver drain
+    exit code), or {} when BENCH_SERVE_REPLICAS=0 disables the phase."""
+    import contextlib
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import numpy as np
+    from handyrl_tpu.serving.fleet import RoutedClient
+    from handyrl_tpu.serving.registry import ModelRegistry
+
+    replicas = int(os.environ.get('BENCH_SERVE_REPLICAS', '2'))
+    if replicas <= 0:
+        return {}
+    root = tempfile.mkdtemp(prefix='bench_fleet_registry.')
+    proc = None
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            reg = ModelRegistry(root)
+            reg.publish('bench', snapshot=wrapper.snapshot(), version=1,
+                        steps=1, promote=True)
+            # the rolling-promote candidate: published, not yet champion
+            reg.publish('bench', snapshot=wrapper.snapshot(), version=2,
+                        steps=2, promote=False)
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'handyrl_tpu.serving', '--fleet',
+             '--env', env_name, '--registry', root, '--port', '0',
+             '--line', 'bench', '--replicas', str(replicas),
+             '--heartbeat', '0.5', '--wait-ms', str(wait_ms),
+             '--max-clients', str(n_clients + 8)],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        _CHILDREN.append(proc)
+        ready = json.loads(proc.stdout.readline())['fleet_ready']
+        port = int(ready['port'])
+        model = 'bench@champion'
+
+        def routed(ci):
+            return RoutedClient('localhost', port, timeout=60.0,
+                                name='f%d' % ci)
+
+        fleet_rps, lat_before, err_f = _serve_client_load(
+            'localhost', port, model, obs, legal, n_clients, warmup,
+            requests, base_seed=41, client_factory=routed)
+
+        # rolling promote under load: every replica warms bench@2 before
+        # the champion flips, so the client-side p99 must not blip
+        admin = RoutedClient('localhost', port, timeout=60.0, name='padm')
+        promote_result = {}
+
+        def do_promote():
+            try:
+                promote_result.update(admin.promote('bench@2', timeout=120))
+            except Exception as exc:  # noqa: BLE001 — reported in the row
+                promote_result['error'] = str(exc)[:200]
+
+        pt = threading.Thread(target=do_promote, name='bench-promote')
+        pt.start()
+        _rps_during, lat_during, err_p = _serve_client_load(
+            'localhost', port, model, obs, legal, n_clients, 0,
+            requests, base_seed=43, client_factory=routed)
+        pt.join(timeout=120)
+        admin.close()
+
+        # resolver SIGTERM: drains managed replicas, exits 75
+        proc.send_signal(_signal.SIGTERM)
+        try:
+            fleet_exit = proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            fleet_exit = None
+
+        def p99(lat):
+            ms = [1e3 * v for v in lat]
+            return round(float(np.percentile(ms, 99)), 2) if ms else 0.0
+
+        # replication scaling needs cores >= replicas: on a starved host
+        # the replicas time-slice one core and fleet_vs_single measures
+        # routing overhead, not the scaling headline — stamp the cores so
+        # the row is interpretable either way
+        cores = os.cpu_count() or 1
+        return {
+            'fleet_replicas': replicas,
+            'fleet_host_cores': cores,
+            'fleet_requests_per_sec': round(fleet_rps, 2),
+            'fleet_vs_single': (round(fleet_rps / single_rps, 2)
+                                if single_rps else 0.0),
+            'fleet_client_errors': err_f + err_p,
+            'promote_p99_before_ms': p99(lat_before),
+            'promote_p99_during_ms': p99(lat_during),
+            'promote_warmed': promote_result.get('warmed', []),
+            'promote_error': promote_result.get('error'),
+            'fleet_drain_exit_code': fleet_exit,
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_serve(probe: dict):
     """BENCH_MODE=serve: the standalone serving tier, CPU-measurable.
 
@@ -848,7 +957,8 @@ def run_serve(probe: dict):
     BENCH_SERVE_WARMUP (per client, default 4), BENCH_SERVE_ENV (default
     HungryGeese), BENCH_SERVE_WAIT_MS (engine batch_wait_ms, default 2),
     BENCH_SERVE_DRAIN (in-flight requests per client through the SIGTERM,
-    default 3).
+    default 3), BENCH_SERVE_REPLICAS (fleet-phase managed replicas,
+    default 2, 0 skips the fleet phase).
     """
     import contextlib
     import shutil
@@ -933,6 +1043,12 @@ def run_serve(probe: dict):
         drain_seconds = time.monotonic() - t_term
         status_client.close()
 
+        # fleet phase: resolver + replicas under the same load, routed —
+        # fleet_vs_single is the replication scaling headline
+        fleet_keys = _serve_fleet_phase(
+            env_name, wrapper, obs, legal, n_clients, requests, warmup,
+            wait_ms, many_rps)
+
         lat_ms = sorted(1e3 * v for v in latencies)
         pct = (lambda q: round(float(np.percentile(lat_ms, q)), 2)) \
             if lat_ms else (lambda q: 0.0)
@@ -951,6 +1067,7 @@ def run_serve(probe: dict):
              drain_unanswered=unanswered,
              drain_seconds=round(drain_seconds, 2),
              drain_exit_code=exit_code,
+             **fleet_keys,
              vs_baseline_def=('%d-client req/s over single-client req/s '
                               'against the same service — the continuous-'
                               'batching concurrency gain' % n_clients),

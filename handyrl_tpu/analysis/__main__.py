@@ -69,9 +69,14 @@ def main(argv=None) -> int:
         for err in result.config_errors:
             strict_failures += 1
             print('graftlint: %s' % err)
-    elif result.config_errors:
+        for msg in result.placeholder_reasons:
+            strict_failures += 1
+            print('graftlint: %s' % msg)
+    else:
         for err in result.config_errors:
             print('graftlint: warning: %s' % err, file=sys.stderr)
+        for msg in result.placeholder_reasons:
+            print('graftlint: warning: %s' % msg, file=sys.stderr)
 
     if args.write_baseline:
         path = args.baseline or os.path.join(root, BASELINE_NAME)

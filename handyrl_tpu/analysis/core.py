@@ -151,9 +151,16 @@ def load_baseline(path: str) -> Tuple[List[BaselineEntry], List[str]]:
     return entries, errors
 
 
+# the reason --write-baseline scaffolds entries with. A scaffolded reason
+# is not a written reason: --strict fails any baseline entry or pragma
+# still carrying it (non-strict runs keep suppressing, with a warning, so
+# the baseline stays usable while the reasons are being written).
+PLACEHOLDER_REASON = 'TODO: justify this exemption'
+
+
 def write_baseline(path: str, findings: List[Finding]):
     entries = [{'rule': f.rule, 'path': f.path, 'context': f.context,
-                'reason': 'TODO: justify this exemption'}
+                'reason': PLACEHOLDER_REASON}
                for f in findings]
     # one entry per key: several findings on identical lines (e.g. the
     # reference builder's draw repeated in the arena twin) share one excuse
@@ -177,15 +184,22 @@ class LintResult:
     pragma_errors: List[Finding] = field(default_factory=list)
     stale_baseline: List[BaselineEntry] = field(default_factory=list)
     config_errors: List[str] = field(default_factory=list)
+    # suppressions whose reason is still the --write-baseline scaffold
+    # placeholder: they suppress (non-strict) but fail --strict
+    placeholder_reasons: List[str] = field(default_factory=list)
 
 
 def apply_suppressions(findings: List[Finding], sources: Dict[str, SourceFile],
                        baseline: List[BaselineEntry]) -> LintResult:
     """Split raw findings into live / baselined / pragma-suppressed, flag
-    reasonless pragmas, and detect stale baseline entries."""
+    reasonless pragmas, and detect stale baseline entries. Suppressions
+    whose reason is still :data:`PLACEHOLDER_REASON` are collected into
+    ``placeholder_reasons`` — the mandatory-reason contract is not
+    satisfied by the scaffold text ``--write-baseline`` emitted."""
     result = LintResult()
     used_keys = set()
     baseline_keys = {e.key() for e in baseline}
+    flagged_placeholders = set()
     for f in findings:
         src = sources.get(f.path)
         pragma = src.pragma_for(f.rule, f.line) if src else None
@@ -193,6 +207,13 @@ def apply_suppressions(findings: List[Finding], sources: Dict[str, SourceFile],
             pline, reason = pragma
             if reason:
                 result.suppressed.append(f)
+                if (reason.strip() == PLACEHOLDER_REASON
+                        and (f.path, pline) not in flagged_placeholders):
+                    flagged_placeholders.add((f.path, pline))
+                    result.placeholder_reasons.append(
+                        '%s:%d: %s pragma reason is still the scaffold '
+                        'placeholder %r — justify the exemption'
+                        % (f.path, pline, f.rule, PLACEHOLDER_REASON))
             else:
                 result.pragma_errors.append(Finding(
                     f.rule, f.path, pline,
@@ -207,4 +228,10 @@ def apply_suppressions(findings: List[Finding], sources: Dict[str, SourceFile],
             continue
         result.findings.append(f)
     result.stale_baseline = [e for e in baseline if e.key() not in used_keys]
+    for e in baseline:
+        if e.reason.strip() == PLACEHOLDER_REASON and e.key() in used_keys:
+            result.placeholder_reasons.append(
+                '%s: %s baseline reason is still the scaffold placeholder '
+                '%r — justify the exemption (context %r)'
+                % (e.path, e.rule, PLACEHOLDER_REASON, e.context[:60]))
     return result

@@ -128,7 +128,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     'serving': {
         'port': 9997,            # service listen port (main.py --serve); 0 = ephemeral (reported on the ready line)
         'host': '',              # service bind host ('' = all interfaces)
-        'endpoint': '',          # 'host:port' of a remote InferenceService; engine-mode workers dial it instead of the in-Gather engine (same deadlines/retries/circuit-breaker; a dead service degrades to the local path byte-identically)
+        'endpoint': '',          # 'host:port' of a remote InferenceService (or a comma-separated list of replica endpoints); engine-mode workers dial it instead of the in-Gather engine (same deadlines/retries/circuit-breaker; with several endpoints a dead replica fails over to the next, and only when ALL are down does the worker degrade to the local path byte-identically)
         'line': 'default',       # model line used by the learner's publish hook and for resolving bare-integer request ids ('<line>@<mid>')
         'registry_dir': '',      # ModelRegistry root (registry.json + owned version files); '' = model_dir
         'publish': False,        # learner: register every numbered checkpoint with the registry as '<line>@<epoch>' (pinning it against keep_checkpoints GC)
@@ -137,6 +137,30 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'max_clients': 64,       # admission control: connections past this are refused with an error frame (serve_shed_total) instead of queueing unboundedly
         'drain_timeout': 30.0,   # graceful-drain deadline (s) on SIGTERM: every accepted request is answered before exit 75 (the PreemptionGuard supervisor contract)
         'metrics_port': 0,       # service-side Prometheus /metrics port (0 = exporter off)
+        'lock_timeout': 10.0,    # registry manifest-lock deadline (s): a mutation that cannot take the cross-process flock within it raises RegistryLockTimeout (counted registry_lock_timeouts_total) instead of hanging on a wedged peer
+
+        # serving fleet (serving/fleet.py, docs/serving.md "Serving fleet"):
+        # a ServiceResolver fronting N InferenceService replicas — replicas
+        # register + heartbeat SLO snapshots, clients route through the
+        # resolver with per-replica circuit breakers, and an optional
+        # autoscaler admits/drains replicas off the p99/shed SLO
+        'fleet': {
+            'resolver': '',              # 'host:port' of the ServiceResolver a replica registers with (and heartbeats to); '' = standalone service, no fleet membership
+            'port': 0,                   # resolver listen port (main.py --serve-fleet); 0 = ephemeral (reported on the fleet_ready line)
+            'replica': '',               # this replica's stable name; '' = resolver-assigned. A respawned replica re-registering under its old name is re-admitted immediately (the healthy round trip)
+            'advertise': '',             # endpoint host advertised to the resolver ('' = the bind host, or 127.0.0.1 when binding all interfaces)
+            'heartbeat_interval': 2.0,   # replica -> resolver liveness + SLO beacon period (s)
+            'heartbeat_timeout': 10.0,   # resolver quarantines a replica silent for this long (s); must exceed heartbeat_interval
+            'refresh_interval': 2.0,     # router-side replica-table refresh period (s); failures also force a refresh
+            'replicas': 2,               # replicas the resolver spawns and supervises under --serve-fleet (0 = externally-managed replicas only)
+            'min_replicas': 1,           # autoscaler floor: idle-drain never shrinks the healthy fleet below this
+            'max_replicas': 4,           # autoscaler ceiling: SLO-breach admission never grows past this
+            'autoscale': False,          # consume the heartbeat SLO snapshots: sustained p99/shed breach admits a standby replica, sustained idleness drains one through the SIGTERM graceful-drain contract
+            'slo_p99_ms': 0.0,           # autoscaler p99 latency breach threshold (ms); 0 = breach only on request sheds
+            'breach_window': 10.0,       # SLO breach must persist this long (s) before a replica is admitted
+            'idle_window': 60.0,         # fleet must be fully idle this long (s) before a replica is drained
+            'quarantine_period': 30.0,   # quarantine length (s) before a silent replica is speculatively re-admitted (a re-registration re-admits it immediately)
+        },
     },
 
     # unified telemetry (docs/observability.md): metric registry + spans +
@@ -315,10 +339,40 @@ def validate(args: Dict[str, Any]) -> None:
     assert str(srv.get('line', 'default')).strip(), \
         'serving.line must be a non-empty model-line name'
     endpoint = str(srv.get('endpoint') or '')
-    if endpoint:
-        _ep_host, _, ep_port = endpoint.rpartition(':')
+    for one in filter(None, (e.strip() for e in endpoint.split(','))):
+        _ep_host, _, ep_port = one.rpartition(':')
         assert ep_port.isdigit() and 0 < int(ep_port) <= 65535, \
-            "serving.endpoint must look like 'host:port' (got %r)" % endpoint
+            "serving.endpoint entries must look like 'host:port' (got %r)" \
+            % one
+    assert float(srv.get('lock_timeout', 10.0)) > 0, \
+        'serving.lock_timeout must be > 0'
+    flt = srv.get('fleet') or {}
+    for key in ('heartbeat_interval', 'heartbeat_timeout', 'refresh_interval',
+                'breach_window', 'idle_window', 'quarantine_period'):
+        if flt.get(key) is not None:
+            assert float(flt[key]) > 0, 'serving.fleet.%s must be > 0' % key
+    if flt.get('heartbeat_timeout') and flt.get('heartbeat_interval'):
+        assert float(flt['heartbeat_timeout']) \
+            > float(flt['heartbeat_interval']), \
+            'serving.fleet.heartbeat_timeout must exceed heartbeat_interval ' \
+            'or every live replica is quarantined between beacons'
+    if flt.get('port') is not None:
+        assert 0 <= int(flt['port']) <= 65535, \
+            'serving.fleet.port must be a TCP port (0 = ephemeral)'
+    assert int(flt.get('replicas', 2)) >= 0, \
+        'serving.fleet.replicas must be >= 0 (0 = external replicas only)'
+    assert int(flt.get('min_replicas', 1)) >= 1, \
+        'serving.fleet.min_replicas must be >= 1'
+    assert int(flt.get('max_replicas', 4)) >= int(flt.get('min_replicas', 1)), \
+        'serving.fleet.max_replicas must be >= min_replicas'
+    assert float(flt.get('slo_p99_ms', 0.0)) >= 0, \
+        'serving.fleet.slo_p99_ms must be >= 0 (0 = breach on sheds only)'
+    resolver = str(flt.get('resolver') or '')
+    if resolver:
+        _r_host, _, r_port = resolver.rpartition(':')
+        assert r_port.isdigit() and 0 < int(r_port) <= 65535, \
+            "serving.fleet.resolver must look like 'host:port' (got %r)" \
+            % resolver
     par = ta.get('parallel') or {}
     assert int(par.get('model_parallel', 1)) >= 1, \
         'parallel.model_parallel must be >= 1 (1 = no tensor parallelism)'

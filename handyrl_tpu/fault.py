@@ -313,6 +313,39 @@ class FleetController:
         self._softs[host].extend([now] * max(1, int(n)))
         self._reassess(host, now)
 
+    # -- operator/supervisor overrides -------------------------------------
+    # The serving fleet (serving/fleet.py) reuses this state machine over
+    # inference replicas, where two transitions have no organic fault feed:
+    # a deliberate scale-down drain, and a replica that proved itself
+    # healthy by re-registering (a respawn) before the quarantine clock ran.
+
+    def force_drain(self, host: str):
+        """Deliberately drain ``host`` (autoscaler scale-down / operator
+        action): stop fresh work now, quarantine once its book empties."""
+        self.observe(host)
+        if self.state(host) in (HOST_HEALTHY, HOST_DEGRADED):
+            self._set(host, HOST_DRAINING)
+
+    def readmit(self, host: str):
+        """Re-admit ``host`` immediately with a cleared fault history —
+        used when a quarantined replica demonstrably recovered (it
+        re-registered with the resolver) before its quarantine expired."""
+        self.observe(host)
+        self._strands[host].clear()
+        self._softs[host].clear()
+        self._until.pop(host, None)
+        if self.state(host) != HOST_HEALTHY:
+            self._set(host, HOST_HEALTHY)
+            self.stats['readmitted'] += 1
+
+    def forget(self, host: str):
+        """Drop ``host`` from the book entirely (replica deliberately
+        retired; its key must not linger in snapshots or gauges)."""
+        self._state.pop(host, None)
+        self._strands.pop(host, None)
+        self._softs.pop(host, None)
+        self._until.pop(host, None)
+
     # -- transitions -------------------------------------------------------
 
     def _set(self, host: str, state: str):

@@ -195,11 +195,28 @@ class EngineClient:
         # against the service registry. Everything else — deadlines,
         # retries, the circuit breaker, the byte-identical local fallback —
         # is the same machinery, so a dead service degrades exactly like a
-        # dead in-Gather engine.
+        # dead in-Gather engine. The endpoint may be a comma-separated
+        # replica list (and/or a serving.fleet.resolver to fetch the live
+        # table from): a dead replica rotates to the next one, and only an
+        # all-replicas-down fleet degrades to per-worker inference.
+        flt = dict(srv.get('fleet') or {})
         self.endpoint = str(srv.get('endpoint') or '')
+        self._endpoints = [e.strip() for e in self.endpoint.split(',')
+                           if e.strip()]
+        self._resolver = str(flt.get('resolver') or '')
+        self._resolver_refresh = max(0.5, float(flt.get('refresh_interval',
+                                                        2.0)))
+        self._resolver_next = 0.0      # next fleet-table fetch
+        self._remote_mode = bool(self._endpoints or self._resolver)
         self._line = str(srv.get('line', 'default'))
         self._remote = None            # lazy FramedConnection to the service
+        self._remote_ep = ''           # endpoint self._remote targets
+        self._ep_idx = 0               # rotation cursor over _endpoints
+        self._ep_down: Dict[str, float] = {}     # endpoint -> retry-at
+        self._ep_backoff: Dict[str, Backoff] = {}
         self._m_dials = telemetry.counter('worker_engine_remote_dials_total')
+        self._m_rotations = telemetry.counter(
+            'worker_engine_endpoint_rotations_total')
         self.timeout = max(0.05, float(inf.get('request_timeout', 10.0)))
         self.retries = max(0, int(inf.get('request_retries', 1)))
         self.failover = bool(inf.get('failover', True))
@@ -259,11 +276,12 @@ class EngineClient:
         if engine_path:
             self._pending[rid] = rec
             if not self._send_engine(rid, rec):
-                # dead service endpoint: fail over NOW instead of burning
-                # the request deadline on a socket that never opened
+                # every service replica is down: fail over NOW instead of
+                # burning the request deadline on sockets that never opened
                 self._local_box[rid] = self._fail(
                     rid, rec,
-                    'service endpoint %s unreachable' % self.endpoint)
+                    'service endpoint(s) %s unreachable'
+                    % (self.endpoint or self._resolver))
         else:
             self._local_box[rid] = self._local_reply(rec)
         return rid
@@ -287,6 +305,10 @@ class EngineClient:
             if reply is None:                     # deadline expired
                 self._m_timeouts.inc()
                 if attempt + 1 < attempts:
+                    # a silent service endpoint is down-marked before the
+                    # resend so the redial rotates to another replica (the
+                    # blackholed-replica case; no-op on the gather pipe)
+                    self._drop_remote()
                     # resend under the same rid: if BOTH replies eventually
                     # arrive, the second is absorbed as stale
                     if not self._send_engine(rid, rec):
@@ -308,20 +330,68 @@ class EngineClient:
 
     # -- internals ---------------------------------------------------------
 
+    def _refresh_endpoints(self):
+        """Fetch the routable replica table from the fleet resolver (when
+        one is configured), replacing the endpoint rotation; a resolver
+        failure keeps the stale list — the data plane outlives it."""
+        now = time.monotonic()
+        if not self._resolver or now < self._resolver_next:
+            return
+        self._resolver_next = now + self._resolver_refresh
+        try:
+            from .serving.client import (ServiceClient, ServiceUnavailable,
+                                         parse_endpoint)
+            host, port = parse_endpoint(self._resolver)
+            probe = ServiceClient(host, port, timeout=2.0, dial_retries=0)
+            try:
+                table = probe.fleet(timeout=2.0).get('replicas') or []
+            finally:
+                probe.close()
+        except (OSError, ConnectionError, EOFError, ValueError,
+                TimeoutError, RuntimeError):
+            return
+        fresh = [str(r.get('endpoint')) for r in table
+                 if r.get('state') in ('healthy', 'degraded')
+                 and not r.get('draining') and r.get('endpoint')]
+        if fresh and sorted(fresh) != sorted(self._endpoints):
+            _LOG.info('worker %d: fleet resolver lists %d routable '
+                      'replica(s): %s', self.namespace, len(fresh),
+                      ', '.join(fresh))
+            self._endpoints = fresh
+
+    def _pick_endpoint(self) -> str:
+        """Next admissible endpoint in rotation; an endpoint stays skipped
+        until its down-mark expires. All down -> the soonest-retryable one
+        (so a fleet-wide blip still probes instead of deadlocking)."""
+        self._refresh_endpoints()
+        if not self._endpoints:
+            raise OSError('no service endpoints known (resolver %s has no '
+                          'routable replicas)' % (self._resolver or '-'))
+        now = time.monotonic()
+        n = len(self._endpoints)
+        for off in range(n):
+            ep = self._endpoints[(self._ep_idx + off) % n]
+            if self._ep_down.get(ep, 0.0) <= now:
+                self._ep_idx = (self._ep_idx + off) % n
+                return ep
+        return min(self._endpoints, key=lambda e: self._ep_down.get(e, 0.0))
+
     def _infer_conn(self):
         """The connection engine frames ride: the gather pipe, or — with a
-        ``serving.endpoint`` configured — a lazily-dialed TCP link to the
-        standalone InferenceService."""
-        if not self.endpoint:
+        ``serving.endpoint``/fleet resolver configured — a lazily-dialed
+        TCP link to one of the InferenceService replicas."""
+        if not self._remote_mode:
             return self.conn
         if self._remote is None:
             from .connection import connect_socket_connection
-            host, _, port = self.endpoint.rpartition(':')
+            ep = self._pick_endpoint()
+            host, _, port = ep.rpartition(':')
             self._remote = connect_socket_connection(host or 'localhost',
                                                      int(port))
+            self._remote_ep = ep
             self._m_dials.inc()
             _LOG.info('worker %d: dialed inference service %s',
-                      self.namespace, self.endpoint)
+                      self.namespace, ep)
         return self._remote
 
     def _drop_remote(self):
@@ -331,6 +401,19 @@ class EngineClient:
             except Exception:
                 pass
             self._remote = None
+        ep = self._remote_ep
+        if ep:
+            # down-mark the endpoint so the next dial rotates to another
+            # replica; the mark expires on a per-endpoint backoff
+            self._remote_ep = ''
+            backoff = self._ep_backoff.setdefault(
+                ep, Backoff(initial=0.5, maximum=15.0))
+            self._ep_down[ep] = time.monotonic() + backoff.next_delay()
+            if len(self._endpoints) > 1:
+                self._m_rotations.inc()
+                _LOG.warning('worker %d: service replica %s dropped; '
+                             'rotating to the next endpoint',
+                             self.namespace, ep)
 
     def _send_engine(self, rid: int, rec: Dict[str, Any]) -> bool:
         """Post one request on the engine path. False means the remote
@@ -338,18 +421,22 @@ class EngineClient:
         fails the request over; the gather-pipe path never fails here (a
         dead pipe is fatal to the worker, as before)."""
         body = {'rid': rid, **rec}
-        if not self.endpoint:
+        if not self._remote_mode:
             self.conn.send((INFER_KIND, body))
             return True
         # the service resolves models by name against its registry; the
         # learner's publish hook registers epoch E as '<line>@<E>'
         body['model'] = '%s@%d' % (self._line, int(rec['mid']))
-        try:
-            self._infer_conn().send((INFER_KIND, body))
-            return True
-        except (OSError, ConnectionError, EOFError, ValueError):
-            self._drop_remote()
-            return False
+        # one attempt per known replica: a dead endpoint down-marks and
+        # rotates; False only when the WHOLE fleet refused the frame
+        attempts = max(1, len(self._endpoints))
+        for _attempt in range(attempts):
+            try:
+                self._infer_conn().send((INFER_KIND, body))
+                return True
+            except (OSError, ConnectionError, EOFError, ValueError):
+                self._drop_remote()
+        return False
 
     def _poll(self, conn, timeout: float) -> bool:
         poll = getattr(conn, 'poll', None)
@@ -367,7 +454,7 @@ class EngineClient:
                     return None
                 msg = conn.recv()
             except (OSError, ConnectionError, EOFError):
-                if not self.endpoint:
+                if not self._remote_mode:
                     raise          # a dead gather pipe is fatal (unchanged)
                 self._drop_remote()
                 return None        # treated as a timeout: retry/fail over
@@ -389,6 +476,10 @@ class EngineClient:
 
     def _settle_ok(self, rid: int):
         self._pending.pop(rid, None)
+        if self._remote_ep:
+            # the replica answered: clear its down-mark and backoff
+            self._ep_down.pop(self._remote_ep, None)
+            self._ep_backoff.pop(self._remote_ep, None)
         if self._probing_rid == rid:
             self._probing_rid = None
         if not self.engine_ok:

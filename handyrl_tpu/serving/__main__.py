@@ -38,16 +38,62 @@ def main(argv=None) -> int:
                     help='override inference.batch_wait_ms')
     ap.add_argument('--max-batch', type=int, default=None,
                     help='override inference.max_batch')
+    # fleet membership (replica mode): register + heartbeat against a
+    # resolver; a resolver-directed drain exits 75 like a SIGTERM drain
+    ap.add_argument('--resolver', default='',
+                    help='fleet resolver endpoint (host:port) to register '
+                         'against (serving.fleet.resolver)')
+    ap.add_argument('--replica', default='',
+                    help='fleet replica name to register under (default: '
+                         'resolver-assigned)')
+    ap.add_argument('--heartbeat', type=float, default=None,
+                    help='override serving.fleet.heartbeat_interval')
+    ap.add_argument('--heartbeat-timeout', type=float, default=None,
+                    help='override serving.fleet.heartbeat_timeout')
+    # resolver mode: run the fleet control plane + managed replicas
+    ap.add_argument('--fleet', action='store_true',
+                    help='run a fleet resolver (+ --replicas managed '
+                         'replica subprocesses) instead of one service')
+    ap.add_argument('--replicas', type=int, default=None,
+                    help='managed replicas the resolver spawns '
+                         '(serving.fleet.replicas)')
+    ap.add_argument('--min-replicas', type=int, default=None)
+    ap.add_argument('--max-replicas', type=int, default=None)
+    ap.add_argument('--autoscale', action='store_true',
+                    help='enable the SLO-driven autoscaler')
+    ap.add_argument('--slo-p99-ms', type=float, default=None,
+                    help='autoscaler p99 latency target '
+                         '(serving.fleet.slo_p99_ms)')
     args = ap.parse_args(argv)
 
     from ..config import apply_defaults
-    from .service import serve_main
 
     inference = {}
     if args.wait_ms is not None:
         inference['batch_wait_ms'] = float(args.wait_ms)
     if args.max_batch is not None:
         inference['max_batch'] = int(args.max_batch)
+    fleet = {}
+    if args.resolver:
+        fleet['resolver'] = args.resolver
+    if args.replica:
+        fleet['replica'] = args.replica
+    if args.heartbeat is not None:
+        fleet['heartbeat_interval'] = float(args.heartbeat)
+    if args.heartbeat_timeout is not None:
+        fleet['heartbeat_timeout'] = float(args.heartbeat_timeout)
+    if args.fleet:
+        fleet['port'] = args.port
+        if args.replicas is not None:
+            fleet['replicas'] = int(args.replicas)
+        if args.min_replicas is not None:
+            fleet['min_replicas'] = int(args.min_replicas)
+        if args.max_replicas is not None:
+            fleet['max_replicas'] = int(args.max_replicas)
+        if args.autoscale:
+            fleet['autoscale'] = True
+        if args.slo_p99_ms is not None:
+            fleet['slo_p99_ms'] = float(args.slo_p99_ms)
     cfg = apply_defaults({
         'env_args': {'env': args.env},
         'train_args': {
@@ -58,10 +104,16 @@ def main(argv=None) -> int:
                 'max_clients': args.max_clients,
                 'drain_timeout': args.drain_timeout,
                 'metrics_port': args.metrics_port,
+                'fleet': fleet,
             },
         },
     })
-    serve_main(cfg, [])
+    if args.fleet:
+        from .fleet import resolver_main
+        resolver_main(cfg, [])
+    else:
+        from .service import serve_main
+        serve_main(cfg, [])
     return 0
 
 

@@ -26,8 +26,13 @@ import numpy as np
 
 from .. import telemetry
 from ..connection import INFER_KIND, connect_socket_connection, is_infer
+from ..fault import Backoff
 
 _LOG = telemetry.get_logger('serving')
+
+# transport-layer exceptions that mean "the socket died", as opposed to a
+# service-sent error frame (ValueError covers framing-layer corruption)
+_TRANSPORT_ERRORS = (OSError, ConnectionError, EOFError, ValueError)
 
 # Admin frames on a service connection (status / resolve / drain probes).
 # Rides next to INFER_KIND; the Hub passes both through untyped.
@@ -59,6 +64,16 @@ class ServiceError(RuntimeError):
     """The service answered a request with an error reply."""
 
 
+class ServiceUnavailable(RuntimeError):
+    """Transport-level failure: the service could not be dialed, or the
+    socket died before a reply landed. DISTINCT from :class:`ServiceError`
+    (the service itself answered with an error frame): an unavailable
+    service never saw — or never answered — the request, and because
+    requests are pure in ``(model@version, obs, seed)`` the caller (or the
+    fleet router) may safely replay it against another replica for a
+    byte-identical reply."""
+
+
 class ServiceClient:
     """One client connection to an InferenceService endpoint.
 
@@ -66,23 +81,61 @@ class ServiceClient:
     one engine batch, like the worker's act_send/act_recv); ``request`` is
     the one-shot convenience. Thread-safe for one submitter at a time per
     instance — concurrent load generators should hold one client each.
+
+    Dialing retries ``dial_retries`` times with jittered backoff before
+    raising :class:`ServiceUnavailable` (a restarting replica's listen
+    socket is down for tens of milliseconds; callers should not crash on
+    that). A socket that dies later surfaces as :class:`ServiceUnavailable`
+    from ``submit``/``collect``; the next ``submit`` redials.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 name: str = ''):
-        self.conn = connect_socket_connection(host, int(port))
+                 name: str = '', dial_retries: int = 3,
+                 dial_backoff: float = 0.2):
+        self.host = host
+        self.port = int(port)
         self.timeout = float(timeout)
         self.name = name
+        self.dial_retries = max(0, int(dial_retries))
+        self.dial_backoff = float(dial_backoff)
+        self.conn = None
         self._rid = 0
         self._box: Dict[int, Dict[str, Any]] = {}   # rid -> early reply
         self._admin: deque = deque()                # out-of-band serve frames
         self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
+        backoff = Backoff(initial=self.dial_backoff, maximum=2.0)
+        last: Optional[BaseException] = None
+        for attempt in range(self.dial_retries + 1):
+            try:
+                self.conn = connect_socket_connection(self.host, self.port)
+                return
+            except _TRANSPORT_ERRORS as exc:
+                last = exc
+                if attempt < self.dial_retries:
+                    time.sleep(backoff.next_delay())
+        self.conn = None
+        raise ServiceUnavailable(
+            'cannot dial service %s:%d after %d attempt(s): %s'
+            % (self.host, self.port, self.dial_retries + 1, last))
+
+    def _drop(self, why: BaseException) -> ServiceUnavailable:
+        """Close the dead socket and build the exception to raise; replies
+        in flight on it are gone (the rid book dies with the socket)."""
+        self.close()
+        return ServiceUnavailable(
+            'connection to service %s:%d lost: %s' % (self.host, self.port,
+                                                      why))
 
     def close(self):
-        try:
-            self.conn.close()
-        except Exception:
-            pass
+        conn, self.conn = self.conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
 
     # -- request path ------------------------------------------------------
 
@@ -102,7 +155,7 @@ class ServiceClient:
             body['legal'] = [int(a) for a in legal]
         if seed is not None:
             body['seed'] = [int(s) for s in seed]
-        self.conn.send((INFER_KIND, body))
+        self._send((INFER_KIND, body))
         return rid
 
     def collect(self, rid: int, timeout: Optional[float] = None
@@ -133,7 +186,7 @@ class ServiceClient:
 
     def _call_admin(self, body: Dict[str, Any],
                     timeout: Optional[float] = None) -> Dict[str, Any]:
-        self.conn.send((SERVE_KIND, body))
+        self._send((SERVE_KIND, body))
         reply = self._await(is_serve, timeout)
         if reply is None:
             raise TimeoutError('no %r reply from the service'
@@ -151,20 +204,43 @@ class ServiceClient:
         return self._call_admin({'op': 'resolve', 'model': str(spec)},
                                 timeout)
 
+    def fleet(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """The fleet replica table (a resolver answers it; a plain service
+        answers an unknown-op error body — see ``model_from_spec``)."""
+        return self._call_admin({'op': 'fleet'}, timeout)
+
     # -- internals ---------------------------------------------------------
+
+    def _send(self, msg):
+        """Frame out one message, redialing a previously-dropped socket;
+        transport death raises :class:`ServiceUnavailable` (retryable)."""
+        if self.conn is None:
+            self._connect()
+        try:
+            self.conn.send(msg)
+        except _TRANSPORT_ERRORS as exc:
+            raise self._drop(exc)
 
     def _await(self, want, timeout: Optional[float]):
         """Next frame matching ``want``; early inference replies are boxed,
-        stray admin frames queued. None on deadline."""
+        stray admin frames queued. None on deadline; a dead socket raises
+        :class:`ServiceUnavailable` (retryable), never a raw OSError."""
         if want is is_serve and self._admin:
             return (SERVE_KIND, self._admin.popleft())
+        if self.conn is None:
+            raise ServiceUnavailable(
+                'connection to service %s:%d is down (pending replies died '
+                'with it)' % (self.host, self.port))
         deadline = time.monotonic() + (self.timeout if timeout is None
                                        else float(timeout))
         while True:
             remaining = deadline - time.monotonic()
-            if remaining <= 0 or not self.conn.poll(remaining):
-                return None
-            msg = self.conn.recv()
+            try:
+                if remaining <= 0 or not self.conn.poll(remaining):
+                    return None
+                msg = self.conn.recv()
+            except _TRANSPORT_ERRORS as exc:
+                raise self._drop(exc)
             if want(msg):
                 return msg
             if is_infer(msg) and isinstance(msg[1], dict):
@@ -203,7 +279,13 @@ class RemoteServiceModel:
 
 def model_from_spec(spec: str, timeout: float = 10.0) -> RemoteServiceModel:
     """``'serve://host:port/line@selector'`` -> a connected proxy model
-    (owning its client connection)."""
+    (owning its client connection).
+
+    The endpoint may name either a single service or a fleet resolver: one
+    ``fleet`` probe at connect time (a plain service answers an unknown-op
+    error body) decides, and a resolver endpoint gets a
+    :class:`~.fleet.RoutedClient` — so eval ``serve://`` specs transparently
+    gain replica failover when pointed at a resolver."""
     rest = str(spec)
     if rest.startswith('serve://'):
         rest = rest[len('serve://'):]
@@ -212,5 +294,14 @@ def model_from_spec(spec: str, timeout: float = 10.0) -> RemoteServiceModel:
         raise ValueError('serve:// spec %r carries no line@selector path'
                          % spec)
     host, port = parse_endpoint(endpoint)
-    return RemoteServiceModel(ServiceClient(host, port, timeout=timeout),
-                              model)
+    client = ServiceClient(host, port, timeout=timeout)
+    try:
+        probe = client.fleet(timeout=min(timeout, 5.0))
+    except (TimeoutError, ServiceUnavailable):
+        probe = {}
+    if probe.get('fleet'):
+        client.close()
+        from .fleet import RoutedClient   # lazy: fleet imports this module
+        return RemoteServiceModel(RoutedClient(host, port, timeout=timeout),
+                                  model)
+    return RemoteServiceModel(client, model)

@@ -1,0 +1,1009 @@
+"""Serving fleet: a resolver/router tier over N InferenceService replicas.
+
+PR 10 made inference a product tier, but a single process: one SIGKILL
+took it down. This module horizontally replicates it with zero-loss
+failover, composing pieces the repo already owns:
+
+* :class:`ServiceResolver` — the control plane. Replicas register and
+  heartbeat liveness + a live SLO snapshot (p99, shed, inflight); the
+  resolver runs the :class:`~..fault.FleetController` state machine
+  (healthy → degraded → draining → quarantined) over them, supervises the
+  replica subprocesses it spawned (respawning crashed ones under their old
+  name, which re-admits them — the healthy→quarantined→healthy round
+  trip), and optionally autoscales: a sustained SLO breach admits a
+  standby replica, sustained idleness drains one through the PR 10
+  SIGTERM graceful-drain contract (every accepted request answered,
+  exit 75).
+
+* :class:`RoutedClient` — the data plane. Same surface as
+  :class:`~.client.ServiceClient` but resolves replicas through the
+  resolver and carries one :class:`ReplicaBreaker` per replica: a request
+  that dials a dead or draining replica opens that breaker and is
+  transparently replayed against a healthy one. Requests are pure in
+  ``(model@version, obs, seed)`` (the PR 5 contract), so the replayed
+  reply is byte-identical — a replica SIGKILL mid-burst is invisible to
+  callers. Half-open probes re-admit recovered replicas.
+
+* **Rolling promotes** — ``{'op': 'promote'}`` walks the fleet replica by
+  replica, having each one materialize + compile the candidate version
+  (the ``warm`` admin op) before the registry champion flips, so the swap
+  never blips client p99.
+
+Topology (see docs/serving.md "Serving fleet")::
+
+    clients (RoutedClient / EngineClient / serve:// eval specs)
+        │ fleet table + per-replica breakers        control plane
+        ▼                                           ┌──────────────┐
+    replica r0  replica r1  …  replica rN  ◀──────▶ │ServiceResolver│
+    (InferenceService, one registry)  register +    └──────────────┘
+                                      heartbeat SLO   │ autoscaler,
+                                                      ▼ supervision
+                                                  spawn / SIGTERM
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..connection import (FramedConnection, Hub, INFER_KIND,
+                          open_socket_connection, is_infer)
+from ..fault import (Backoff, FleetController, HOST_DEGRADED, HOST_HEALTHY)
+from ..guard import PREEMPT_EXIT_CODE, PreemptionGuard
+from .client import (SERVE_KIND, ServiceClient, ServiceError,
+                     ServiceUnavailable, is_serve, parse_endpoint)
+from .registry import ModelRegistry, RegistryError, parse_spec
+
+_LOG = telemetry.get_logger('fleet')
+
+# replica states a router will dispatch to
+_ROUTABLE = (HOST_HEALTHY, HOST_DEGRADED)
+
+
+class ReplicaBreaker:
+    """Per-replica circuit breaker: ``closed`` admits requests; a failure
+    opens it (probe delay doubling per consecutive failure); once the
+    delay elapses ONE half-open probe is admitted — success closes the
+    breaker and resets the backoff, failure re-opens it with a longer
+    delay. Same shape as the worker EngineClient's breaker, but per
+    replica instead of per engine."""
+
+    def __init__(self, initial: float = 0.5, maximum: float = 8.0,
+                 clock=time.monotonic, rng=None):
+        self._backoff = Backoff(initial=initial, maximum=maximum, rng=rng)
+        self._clock = clock
+        self.state = 'closed'
+        self._probe_at = 0.0
+        self._probing = False
+
+    def admits(self) -> bool:
+        """May a request be routed here right now? True while closed, and
+        for exactly one in-flight probe once the reprobe delay elapsed."""
+        if self.state == 'closed':
+            return True
+        return not self._probing and self._clock() >= self._probe_at
+
+    def begin_probe(self):
+        """Mark the half-open probe in flight (call when routing a request
+        to an open breaker that ``admits()``)."""
+        if self.state != 'closed':
+            self._probing = True
+
+    def record_success(self):
+        self.state = 'closed'
+        self._probing = False
+        self._backoff.reset()
+
+    def record_failure(self) -> bool:
+        """Open (or re-open) the breaker; True when this call newly opened
+        a closed breaker."""
+        opened = self.state == 'closed'
+        self.state = 'open'
+        self._probing = False
+        self._probe_at = self._clock() + self._backoff.next_delay()
+        return opened
+
+
+class AutoscalerPolicy:
+    """Pure SLO-driven admit/drain policy over heartbeat snapshots.
+
+    ``decide(replicas)`` consumes the resolver's fleet table (state +
+    p99_ms/shed/inflight per replica) and returns ``'admit'`` (a sustained
+    SLO breach and room below ``max_replicas``), ``'drain'`` (a sustained
+    fully-idle fleet above ``min_replicas``), or None. Breach = any
+    routable replica over ``slo_p99_ms`` (when set) or shedding since the
+    last look. Both conditions must persist (``breach_window`` /
+    ``idle_window``) so one slow batch or one quiet second does not thrash
+    the fleet. Deterministic and clock-injectable: unit-testable from
+    synthetic snapshots."""
+
+    def __init__(self, slo_p99_ms: float = 0.0, breach_window: float = 10.0,
+                 idle_window: float = 60.0, min_replicas: int = 1,
+                 max_replicas: int = 4, clock=time.monotonic):
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.breach_window = float(breach_window)
+        self.idle_window = float(idle_window)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self._clock = clock
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_shed: Dict[str, int] = {}
+
+    def decide(self, replicas: List[Dict[str, Any]]) -> Optional[str]:
+        now = self._clock()
+        routable = [r for r in replicas if r.get('state') in _ROUTABLE]
+        shedding = False
+        for r in routable:
+            name = str(r.get('replica'))
+            shed = int(r.get('shed', 0))
+            if shed > self._last_shed.get(name, 0):
+                shedding = True
+            self._last_shed[name] = shed
+        if not routable:
+            self._breach_since = self._idle_since = None
+            return None
+        breach = shedding or (
+            self.slo_p99_ms > 0.0
+            and any(float(r.get('p99_ms', 0.0)) > self.slo_p99_ms
+                    for r in routable))
+        idle = not breach and all(int(r.get('inflight', 0)) == 0
+                                  for r in routable)
+        if breach:
+            self._idle_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+            if (now - self._breach_since >= self.breach_window
+                    and len(routable) < self.max_replicas):
+                self._breach_since = None
+                return 'admit'
+        elif idle:
+            self._breach_since = None
+            if self._idle_since is None:
+                self._idle_since = now
+            if (now - self._idle_since >= self.idle_window
+                    and len(routable) > self.min_replicas):
+                self._idle_since = None
+                return 'drain'
+        else:
+            self._breach_since = self._idle_since = None
+        return None
+
+
+class ServiceResolver:
+    """The fleet's control plane: a TCP server speaking the SERVE_KIND
+    admin protocol (register / heartbeat / fleet / status / promote /
+    drain) over the same Hub machinery as the service, plus a tick thread
+    running heartbeat-liveness accounting, the FleetController state
+    machine, managed-replica supervision, and the autoscaler.
+
+    ``spawner(name) -> subprocess.Popen`` (set by :func:`resolver_main`,
+    or a test) makes a replica *managed*: the resolver respawns it when it
+    crashes and SIGTERMs it to drain. Externally-run replicas just
+    register and heartbeat; a drain directive rides their heartbeat reply.
+    """
+
+    def __init__(self, args: Dict[str, Any],
+                 spawner: Optional[Callable[[str], Any]] = None,
+                 clock=time.monotonic):
+        srv = dict(args.get('serving') or {})
+        flt = dict(srv.get('fleet') or {})
+        self.host = str(srv.get('host') or '')
+        self.port = int(flt.get('port', 0))
+        self.default_line = str(srv.get('line', 'default'))
+        self.registry_root = str(srv.get('registry_dir')
+                                 or args.get('model_dir', 'models'))
+        self.lock_timeout = float(srv.get('lock_timeout', 10.0))
+        self.heartbeat_timeout = float(flt.get('heartbeat_timeout', 10.0))
+        self.autoscale = bool(flt.get('autoscale', False))
+        self.max_replicas = max(1, int(flt.get('max_replicas', 4)))
+        self.spawner = spawner
+        self._clock = clock
+        self.policy = AutoscalerPolicy(
+            slo_p99_ms=float(flt.get('slo_p99_ms', 0.0)),
+            breach_window=float(flt.get('breach_window', 10.0)),
+            idle_window=float(flt.get('idle_window', 60.0)),
+            min_replicas=int(flt.get('min_replicas', 1)),
+            max_replicas=self.max_replicas, clock=clock)
+
+        self._lock = threading.Lock()
+        # replica name -> {endpoint, pid, slo, last_beat, drain_wanted}
+        self._replicas: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._procs: Dict[str, Any] = {}       # managed  # guarded-by: _lock
+        self._respawn_at: Dict[str, float] = {}          # guarded-by: _lock
+        self._respawn_backoff: Dict[str, Backoff] = {}   # guarded-by: _lock
+        self._next_replica = 0                           # guarded-by: _lock
+        # the state machine is driven from both the dispatch thread
+        # (register/heartbeat) and the tick thread
+        self.controller = FleetController(            # guarded-by: _lock
+            degrade_after=1, quarantine_after=1,
+            health_window=max(30.0, self.heartbeat_timeout * 6),
+            quarantine_period=float(flt.get('quarantine_period', 30.0)),
+            clock=clock)
+
+        self._stop = False
+        self._sock = None
+        self.hub: Optional[Hub] = None
+        self._threads: list = []
+
+        self._m_state = lambda replica: telemetry.gauge(
+            'fleet_replica_state', replica=replica)
+        self._m_transitions = lambda frm, to: telemetry.counter(
+            'fleet_replica_transitions_total', **{'from': frm, 'to': to})
+        self._m_replicas = telemetry.gauge('fleet_replicas')
+        self._m_heartbeats = telemetry.counter('fleet_heartbeats_total')
+        self._m_hb_misses = telemetry.counter('fleet_heartbeat_misses_total')
+        self._m_admits = telemetry.counter('fleet_autoscale_admits_total')
+        self._m_drains = telemetry.counter('fleet_autoscale_drains_total')
+        self._m_respawns = telemetry.counter('fleet_respawns_total')
+        self._m_promotes = telemetry.counter('fleet_rolling_promotes_total')
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> 'ServiceResolver':
+        self._sock = open_socket_connection(self.port)
+        self._sock.listen(64)
+        self._sock.settimeout(0.5)
+        self.port = self._sock.getsockname()[1]
+        self.hub = Hub()
+        for target, name in ((self._accept_loop, 'fleet-accept'),
+                             (self._dispatch_loop, 'fleet-dispatch'),
+                             (self._tick_loop, 'fleet-tick')):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        _LOG.info('fleet: resolver listening on port %d (registry %s)',
+                  self.port, self.registry_root)
+        return self
+
+    def stop(self, drain: bool = True):
+        """SIGTERM every managed replica (graceful drain, exit 75), wait
+        them out, then tear the resolver down."""
+        with self._lock:
+            procs = dict(self._procs)
+        if drain:
+            for name, proc in procs.items():
+                if proc.poll() is None:
+                    _LOG.info('fleet: draining managed replica %r (SIGTERM '
+                              'pid %d)', name, proc.pid)
+                    try:
+                        proc.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+            for name, proc in procs.items():
+                try:
+                    proc.wait(timeout=60)
+                except Exception:
+                    _LOG.error('fleet: replica %r did not exit; killing',
+                               name)
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=10)
+                    except Exception:
+                        pass
+        else:
+            for proc in procs.values():
+                try:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                except Exception:
+                    pass
+        self._stop = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        time.sleep(0.25)      # let hub writers flush final replies
+
+    # -- accept / dispatch -------------------------------------------------
+
+    def _accept_loop(self):
+        import socket as _socket
+        while not self._stop:
+            try:
+                conn, _addr = self._sock.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return
+            self.hub.attach(FramedConnection(conn), liveness=0)
+
+    def _dispatch_loop(self):
+        import queue as _q
+        while not self._stop:
+            try:
+                ep, msg = self.hub.recv(timeout=0.3)
+            except _q.Empty:
+                continue
+            try:
+                if is_serve(msg):
+                    self._admin(ep, msg[1] if isinstance(msg[1], dict)
+                                else {})
+                elif is_infer(msg):
+                    body = msg[1] if isinstance(msg[1], dict) else {}
+                    # control plane only: inference frames are answered
+                    # with an error so a misdirected client fails fast
+                    self.hub.send(ep, (INFER_KIND, {
+                        'rid': body.get('rid'), 'engine_fault': True,
+                        'error': 'resolver is control-plane only; route '
+                                 'requests through a RoutedClient or dial '
+                                 'a replica endpoint'}))
+                else:
+                    self.hub.send(ep, (SERVE_KIND,
+                                       {'error': 'unknown frame kind'}))
+            except Exception as exc:   # noqa: BLE001 — the loop must live
+                _LOG.error('fleet: dispatch error (%s: %s)',
+                           type(exc).__name__, str(exc)[:200])
+
+    def _admin(self, ep, body: Dict[str, Any]):
+        op = body.get('op')
+        if op == 'register':
+            self._register(ep, body)
+        elif op == 'heartbeat':
+            self._heartbeat(ep, body)
+        elif op == 'fleet':
+            self.hub.send(ep, (SERVE_KIND, {'fleet': True,
+                                            'replicas': self.fleet_table()}))
+        elif op == 'status':
+            self.hub.send(ep, (SERVE_KIND, self.stats()))
+        elif op == 'promote':
+            self._promote_async(ep, str(body.get('model')))
+        elif op == 'drain':
+            name = str(body.get('replica') or '')
+            if self._request_drain(name):
+                self.hub.send(ep, (SERVE_KIND, {'ok': True,
+                                                'replica': name}))
+            else:
+                self.hub.send(ep, (SERVE_KIND,
+                                   {'error': 'unknown replica %r' % name}))
+        else:
+            self.hub.send(ep, (SERVE_KIND,
+                               {'error': 'unknown admin op %r' % (op,)}))
+
+    # -- registration / heartbeats -----------------------------------------
+
+    def _name_replica(self) -> str:
+        with self._lock:
+            name = 'r%d' % self._next_replica
+            self._next_replica += 1
+            return name
+
+    def _register(self, ep, body: Dict[str, Any]):
+        endpoint = str(body.get('endpoint') or '')
+        if not endpoint:
+            self.hub.send(ep, (SERVE_KIND,
+                               {'error': 'register carries no endpoint'}))
+            return
+        name = str(body.get('replica') or '') or self._name_replica()
+        now = self._clock()
+        with self._lock:
+            rec = self._replicas.get(name)
+            known = rec is not None
+            if rec is None:
+                rec = self._replicas[name] = {'slo': {},
+                                              'drain_wanted': False}
+            rec['endpoint'] = endpoint
+            rec['pid'] = int(body.get('pid') or 0)
+            rec['last_beat'] = now
+            self.controller.observe(name)
+            recovered = known and self.controller.state(name) != HOST_HEALTHY
+            if recovered:
+                # the replica proved itself alive by re-registering (a
+                # respawn): re-admit now, don't wait out the quarantine
+                self.controller.readmit(name)
+        _LOG.info('fleet: replica %r registered at %s (pid %d)%s',
+                  name, endpoint, int(body.get('pid') or 0),
+                  ' — re-admitted' if recovered else '')
+        self._journal()
+        self.hub.send(ep, (SERVE_KIND, {'ok': True, 'replica': name}))
+
+    def _heartbeat(self, ep, body: Dict[str, Any]):
+        name = str(body.get('replica') or '')
+        slo = dict(body.get('slo') or {})
+        with self._lock:
+            rec = self._replicas.get(name)
+            if rec is None:
+                known = False
+            else:
+                known = True
+                rec['last_beat'] = self._clock()
+                prev_shed = int((rec.get('slo') or {}).get('shed', 0))
+                rec['slo'] = slo
+                if int(slo.get('shed', 0)) > prev_shed:
+                    # shedding load: struggling but alive — a soft fault
+                    self.controller.record_soft_fault(name)
+                drain = bool(rec['drain_wanted'])
+        if not known:
+            self.hub.send(ep, (SERVE_KIND,
+                               {'error': 'unknown replica %r — register '
+                                         'first' % name}))
+            return
+        self._m_heartbeats.inc()
+        self.hub.send(ep, (SERVE_KIND, {'ok': True, 'drain': drain}))
+
+    # -- the tick: liveness, state machine, autoscaler, supervision --------
+
+    def _tick_loop(self):
+        while not self._stop:
+            try:
+                self.tick_once()
+            except Exception as exc:   # noqa: BLE001 — the loop must live
+                _LOG.error('fleet: tick error (%s: %s)',
+                           type(exc).__name__, str(exc)[:200])
+            self._sleep(0.25)
+
+    def tick_once(self):
+        now = self._clock()
+        with self._lock:
+            beats = {n: r['last_beat'] for n, r in self._replicas.items()}
+        for name, last in beats.items():
+            silent = now - last
+            with self._lock:
+                state = self.controller.state(name)
+                if state in _ROUTABLE and silent > self.heartbeat_timeout:
+                    self.controller.record_stranding(name)
+                    missed = True
+                else:
+                    missed = False
+            if missed:
+                self._m_hb_misses.inc()
+                _LOG.warning('fleet: replica %r silent for %.1fs '
+                             '(heartbeat_timeout %.1fs); draining it',
+                             name, silent, self.heartbeat_timeout)
+        with self._lock:
+            # replicas carry no outstanding book at the resolver (clients
+            # replay their own in-flight requests), so draining replicas
+            # quarantine on the next tick
+            self.controller.tick({})
+        if self.autoscale:
+            self._autoscale_step()
+        self._supervise()
+        self._journal()
+
+    def _autoscale_step(self):
+        decision = self.policy.decide(self.fleet_table())
+        if decision == 'admit':
+            if self.spawner is None:
+                _LOG.warning('fleet: autoscaler wants a replica admitted '
+                             'but no spawner is configured')
+                return
+            name = self.admit_replica()
+            if name:
+                self._m_admits.inc()
+                _LOG.warning('fleet: SLO breach sustained — admitted '
+                             'standby replica %r', name)
+        elif decision == 'drain':
+            victim = self._drain_victim()
+            if victim and self._request_drain(victim):
+                self._m_drains.inc()
+                _LOG.warning('fleet: fleet idle — draining replica %r',
+                             victim)
+
+    def _drain_victim(self) -> Optional[str]:
+        """Pick the replica an idle-drain retires: a routable one, managed
+        preferred (we can actually stop it), youngest name last-in
+        first-out."""
+        rows = [r for r in self.fleet_table()
+                if r['state'] in _ROUTABLE and not r['draining']]
+        if not rows:
+            return None
+        with self._lock:
+            managed = set(self._procs)
+        rows.sort(key=lambda r: (r['replica'] in managed, r['replica']))
+        return rows[-1]['replica']
+
+    def _request_drain(self, name: str) -> bool:
+        with self._lock:
+            rec = self._replicas.get(name)
+            if rec is None:
+                return False
+            rec['drain_wanted'] = True
+            self.controller.force_drain(name)
+            proc = self._procs.get(name)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)   # graceful drain, exit 75
+            except OSError:
+                pass
+        self._journal()
+        return True
+
+    def admit_replica(self) -> Optional[str]:
+        """Spawn one managed replica (respecting ``max_replicas``);
+        returns its name, or None at capacity / without a spawner."""
+        if self.spawner is None:
+            return None
+        with self._lock:
+            if len(self._procs) >= self.max_replicas:
+                return None
+        name = self._name_replica()
+        proc = self.spawner(name)
+        with self._lock:
+            self._procs[name] = proc
+        _LOG.info('fleet: spawned managed replica %r (pid %d)', name,
+                  proc.pid)
+        return name
+
+    def _supervise(self):
+        """Reap/respawn managed replica processes: a deliberate drain is
+        retired (forgotten), a crash is respawned under the SAME name
+        after a backoff — its re-registration re-admits it."""
+        with self._lock:
+            procs = dict(self._procs)
+        for name, proc in procs.items():
+            rc = proc.poll()
+            if rc is None:
+                continue
+            with self._lock:
+                rec = self._replicas.get(name)
+                wanted = bool(rec and rec.get('drain_wanted'))
+                if wanted:
+                    self._procs.pop(name, None)
+                    self._replicas.pop(name, None)
+                    self._respawn_at.pop(name, None)
+                    self.controller.forget(name)
+                    state_cleared = True
+                else:
+                    state_cleared = False
+                    due = self._respawn_at.get(name)
+                    now = self._clock()
+                    if due is None:
+                        # a reaped corpse IS a stranding: walk the state
+                        # machine now (healthy -> draining -> quarantined)
+                        # instead of waiting out heartbeat silence — the
+                        # respawn's re-registration re-admits it
+                        self.controller.record_stranding(name)
+                        backoff = self._respawn_backoff.setdefault(
+                            name, Backoff(initial=0.2, maximum=5.0))
+                        self._respawn_at[name] = now + backoff.next_delay()
+                    elif now >= due:
+                        self._respawn_at.pop(name, None)
+                        self._procs[name] = self.spawner(name)
+                        self._m_respawns.inc()
+            if state_cleared:
+                self._m_state(name).set(-1.0)
+                _LOG.info('fleet: replica %r drained and exited %s; '
+                          'retired', name, rc)
+            elif name not in procs or proc.poll() is not None:
+                with self._lock:
+                    respawned = (name in self._procs
+                                 and self._procs[name] is not proc)
+                if respawned:
+                    _LOG.warning('fleet: replica %r (exit %s) respawned '
+                                 'under its old name', name, rc)
+
+    def _journal(self):
+        """Mirror controller transitions onto logs + gauges (the resolver
+        is the one place the whole fleet's state is visible)."""
+        with self._lock:
+            events = self.controller.drain_transitions()
+            states = {name: self.controller.state(name)
+                      for name in self._replicas}
+        for name, frm, to, _t in events:
+            _LOG.warning('fleet: replica %s: %s -> %s', name, frm, to)
+            self._m_transitions(frm, to).inc()
+        for name, state in states.items():
+            self._m_state(name).set(
+                float(telemetry.HOST_STATE_CODES.get(state, -1)))
+        self._m_replicas.set(float(
+            sum(1 for s in states.values() if s in _ROUTABLE)))
+
+    def _sleep(self, seconds: float):
+        deadline = time.monotonic() + seconds
+        while not self._stop and time.monotonic() < deadline:
+            time.sleep(min(0.1, max(0.0, deadline - time.monotonic())))
+
+    # -- rolling promote ---------------------------------------------------
+
+    def _promote_async(self, ep, spec: str):
+        def run():
+            try:
+                result = self.rolling_promote(spec)
+            except (RegistryError, ServiceError, ServiceUnavailable,
+                    RuntimeError, ValueError, TimeoutError) as exc:
+                result = {'error': '%s: %s' % (type(exc).__name__, exc)}
+            self.hub.send(ep, (SERVE_KIND, result))
+
+        t = threading.Thread(target=run, name='fleet-promote', daemon=True)
+        t.start()
+
+    def rolling_promote(self, spec: str) -> Dict[str, Any]:
+        """Walk the fleet replica-by-replica: each routable replica warms
+        (materializes + compiles) the candidate version, and only then the
+        registry champion flips — one atomic manifest swap that every
+        replica is already hot for, so client p99 never blips."""
+        line, selector = parse_spec(spec)
+        registry = ModelRegistry(self.registry_root,
+                                 lock_timeout=self.lock_timeout)
+        version, _meta = registry.resolve(line, selector)
+        warmed = []
+        for row in self.fleet_table():
+            if row['state'] not in _ROUTABLE:
+                continue
+            host, port = parse_endpoint(row['endpoint'])
+            client = ServiceClient(host, port, timeout=120.0,
+                                   name='fleet-promote')
+            try:
+                rep = client._call_admin(
+                    {'op': 'warm', 'model': '%s@%s' % (line, version)},
+                    timeout=120.0)
+            finally:
+                client.close()
+            if rep.get('error'):
+                raise RuntimeError(
+                    'replica %r failed to warm %s@%s: %s — champion NOT '
+                    'flipped' % (row['replica'], line, version,
+                                 rep['error']))
+            warmed.append(row['replica'])
+            _LOG.info('fleet: replica %r warmed %s@%s', row['replica'],
+                      line, version)
+        registry.promote(line, version)
+        self._m_promotes.inc()
+        _LOG.info('fleet: rolling promote of %s@%s complete (%d replica(s) '
+                  'warmed)', line, version, len(warmed))
+        return {'ok': True, 'line': line, 'version': version,
+                'warmed': warmed}
+
+    # -- introspection -----------------------------------------------------
+
+    def fleet_table(self) -> List[Dict[str, Any]]:
+        """The replica table routers consume: name, endpoint, state, and
+        the latest heartbeat SLO numbers."""
+        with self._lock:
+            snap = {n: dict(r) for n, r in self._replicas.items()}
+            states = {n: self.controller.state(n) for n in snap}
+        out = []
+        for name in sorted(snap):
+            rec = snap[name]
+            slo = rec.get('slo') or {}
+            out.append({'replica': name,
+                        'endpoint': str(rec.get('endpoint', '')),
+                        'pid': int(rec.get('pid', 0)),
+                        'state': states[name],
+                        'p50_ms': float(slo.get('p50_ms', 0.0)),
+                        'p99_ms': float(slo.get('p99_ms', 0.0)),
+                        'inflight': int(slo.get('inflight', 0)),
+                        'shed': int(slo.get('shed', 0)),
+                        'draining': bool(slo.get('draining')
+                                         or rec.get('drain_wanted'))})
+        return out
+
+    def wait_routable(self, count: int, timeout: float = 120.0) -> bool:
+        """Block until ``count`` replicas are registered and routable
+        (managed replicas register asynchronously after spawn)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            table = self.fleet_table()
+            if sum(1 for r in table if r['state'] in _ROUTABLE) >= count:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = self.controller.counts()
+            fc_stats = dict(self.controller.stats)
+            managed = sorted(self._procs)
+        return {'resolver': True, 'port': self.port,
+                'registry': self.registry_root, 'autoscale': self.autoscale,
+                'managed': managed, 'counts': counts,
+                'controller': fc_stats, 'replicas': self.fleet_table()}
+
+
+class RoutedClient:
+    """Client-side router over the fleet: the :class:`ServiceClient`
+    surface (submit/collect/request/status/resolve), but every request is
+    dispatched to a routable replica chosen through the resolver's fleet
+    table, guarded by one :class:`ReplicaBreaker` per replica, and — on a
+    dead-socket, timeout, or draining reply — transparently replayed
+    against another replica for a byte-identical answer.
+
+    Thread-safety matches ServiceClient: one submitter at a time per
+    instance; concurrent load generators hold one RoutedClient each.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 name: str = '', refresh_interval: float = 2.0):
+        self.timeout = float(timeout)
+        self.name = name
+        self._resolver = ServiceClient(host, int(port), timeout=timeout,
+                                       name=name or 'router')
+        self._refresh_interval = float(refresh_interval)
+        self._lock = threading.Lock()
+        self._table: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._clients: Dict[str, ServiceClient] = {}  # guarded-by: _lock
+        self._breakers: Dict[str, ReplicaBreaker] = {}  # guarded-by: _lock
+        self._last_refresh = 0.0
+        self._rr = 0          # round-robin cursor
+        self._rid = 0
+        # rid -> (replica, replica-local rid, request kwargs) for replay
+        self._book: Dict[int, Tuple[str, int, Dict[str, Any]]] = {}
+        self._m_requests = lambda replica: telemetry.counter(
+            'router_requests_total', replica=replica)
+        self._m_replays = telemetry.counter('router_replays_total')
+        self._m_breaker_opens = telemetry.counter(
+            'router_breaker_opens_total')
+        self._refresh(force=True)
+
+    def close(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()
+        self._resolver.close()
+
+    # -- replica table -----------------------------------------------------
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self._refresh_interval:
+            return
+        try:
+            reply = self._resolver.fleet(timeout=self.timeout)
+        except (ServiceUnavailable, TimeoutError) as exc:
+            # keep routing on the stale table; the resolver being down
+            # must not take the data plane with it
+            _LOG.warning('router: resolver unreachable (%s); keeping the '
+                         'stale replica table', exc)
+            self._last_refresh = now
+            return
+        if reply.get('error') or not reply.get('fleet'):
+            raise ServiceError(
+                'endpoint is not a fleet resolver: %s'
+                % (reply.get('error') or reply))
+        with self._lock:
+            self._table = {str(r['replica']): r
+                           for r in reply.get('replicas', [])}
+            for gone in set(self._clients) - set(self._table):
+                self._clients.pop(gone).close()
+            self._last_refresh = now
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        self._refresh()     # rate-limited by refresh_interval
+        with self._lock:
+            return [dict(r) for r in self._table.values()]
+
+    # -- routing -----------------------------------------------------------
+
+    def _candidates(self) -> List[str]:
+        """Routable replicas in dispatch order: closed breakers first
+        (round-robin), then open breakers due a half-open probe."""
+        with self._lock:
+            names = [n for n, r in sorted(self._table.items())
+                     if r.get('state') in _ROUTABLE
+                     and not r.get('draining')]
+            closed, probes = [], []
+            for n in names:
+                b = self._breakers.get(n)
+                if b is None or b.state == 'closed':
+                    closed.append(n)
+                elif b.admits():
+                    probes.append(n)
+            if closed:
+                self._rr = (self._rr + 1) % len(closed)
+                closed = closed[self._rr:] + closed[:self._rr]
+        return closed + probes
+
+    def _client(self, name: str) -> ServiceClient:
+        with self._lock:
+            client = self._clients.get(name)
+            endpoint = str(self._table[name]['endpoint'])
+        if client is None:
+            host, port = parse_endpoint(endpoint)
+            client = ServiceClient(host, port, timeout=self.timeout,
+                                   name=self.name, dial_retries=1,
+                                   dial_backoff=0.05)
+            with self._lock:
+                self._clients[name] = client
+        return client
+
+    def _breaker(self, name: str) -> ReplicaBreaker:
+        with self._lock:
+            b = self._breakers.get(name)
+            if b is None:
+                b = self._breakers[name] = ReplicaBreaker()
+            return b
+
+    def _ok(self, name: str):
+        self._breaker(name).record_success()
+
+    def _fail(self, name: str):
+        if self._breaker(name).record_failure():
+            self._m_breaker_opens.inc()
+            _LOG.warning('router: breaker OPEN for replica %r', name)
+        with self._lock:
+            client = self._clients.pop(name, None)
+        if client is not None:
+            client.close()
+
+    def _dispatch(self, req: Dict[str, Any]) -> Tuple[str, int]:
+        """Send ``req`` to the first admissible replica; (replica, local
+        rid). Dial/send failures open that replica's breaker and move on;
+        a second pass runs after a forced table refresh."""
+        last: Optional[BaseException] = None
+        for _attempt in range(2):
+            for name in self._candidates():
+                breaker = self._breaker(name)
+                breaker.begin_probe()
+                try:
+                    client = self._client(name)
+                    sub = client.submit(**req)
+                except ServiceUnavailable as exc:
+                    last = exc
+                    self._fail(name)
+                    continue
+                self._m_requests(name).inc()
+                return name, sub
+            self._refresh(force=True)
+        raise ServiceUnavailable(
+            'no routable replica accepted the request (%d in table): %s'
+            % (len(self._table), last))
+
+    # -- the ServiceClient surface -----------------------------------------
+
+    def submit(self, model: str, obs, hidden=None, legal=None,
+               seed=None) -> int:
+        req = {'model': str(model), 'obs': obs, 'hidden': hidden,
+               'legal': legal, 'seed': seed}
+        self._refresh()
+        name, sub = self._dispatch(req)
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            self._book[rid] = (name, sub, req)
+        return rid
+
+    def collect(self, rid: int, timeout: Optional[float] = None
+                ) -> Dict[str, Any]:
+        with self._lock:
+            entry = self._book.pop(rid, None)
+        if entry is None:
+            raise ValueError('unknown router rid %d' % rid)
+        name, sub, req = entry
+        try:
+            reply = self._client(name).collect(sub, timeout=timeout)
+            self._ok(name)
+            return reply
+        except (ServiceUnavailable, TimeoutError) as exc:
+            self._fail(name)
+            last: BaseException = exc
+        except ServiceError as exc:
+            if 'draining' not in str(exc):
+                raise           # a real error reply: the service answered
+            # a draining replica error-answers everything; it is about to
+            # exit — stop routing there and replay elsewhere
+            self._fail(name)
+            last = exc
+        # replay: requests are pure in (model@version, obs, seed), so the
+        # reply from another replica is byte-identical
+        attempts = max(2, len(self.replicas()) + 1)
+        for _attempt in range(attempts):
+            name2, sub2 = self._dispatch(req)
+            self._m_replays.inc()
+            try:
+                reply = self._client(name2).collect(sub2, timeout=timeout)
+                self._ok(name2)
+                return reply
+            except (ServiceUnavailable, TimeoutError) as exc:
+                self._fail(name2)
+                last = exc
+            except ServiceError as exc:
+                if 'draining' not in str(exc):
+                    raise
+                self._fail(name2)
+                last = exc
+        raise ServiceUnavailable(
+            'request could not be replayed on any replica: %s' % last) \
+            from last
+
+    def request(self, model: str, obs, hidden=None, legal=None, seed=None,
+                timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.collect(self.submit(model, obs, hidden=hidden,
+                                        legal=legal, seed=seed),
+                            timeout=timeout)
+
+    def status(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """The resolver's fleet-wide stats."""
+        return self._resolver.status(timeout=timeout)
+
+    def promote(self, spec: str, timeout: float = 600.0) -> Dict[str, Any]:
+        """Rolling-promote ``line@selector`` across the fleet (blocks
+        until every routable replica warmed and the champion flipped)."""
+        return self._resolver._call_admin({'op': 'promote',
+                                           'model': str(spec)},
+                                          timeout=timeout)
+
+    def resolve(self, spec: str, timeout: Optional[float] = None
+                ) -> Dict[str, Any]:
+        """Resolve ``line@selector`` against a routable replica."""
+        for name in self._candidates():
+            try:
+                return self._client(name).resolve(spec, timeout=timeout)
+            except (ServiceUnavailable, TimeoutError):
+                self._fail(name)
+        raise ServiceUnavailable('no routable replica to resolve against')
+
+
+# ---------------------------------------------------------------------------
+# the --serve-fleet entrypoint
+
+
+def _replica_spawner(sargs: Dict[str, Any], resolver: ServiceResolver
+                     ) -> Callable[[str], Any]:
+    """Build the ``spawner(name)`` closure: one ``python -m
+    handyrl_tpu.serving`` subprocess per replica, registering back against
+    the resolver under its assigned name (ephemeral port; the register op
+    carries the bound endpoint, so the resolver never parses child
+    stdout)."""
+    srv = dict(sargs.get('serving') or {})
+    flt = dict(srv.get('fleet') or {})
+    inf = dict(sargs.get('inference') or {})
+    env_name = str((sargs.get('env') or {}).get('env', 'TicTacToe'))
+
+    def spawn(name: str):
+        cmd = [sys.executable, '-m', 'handyrl_tpu.serving',
+               '--env', env_name,
+               '--registry', resolver.registry_root,
+               '--port', '0',
+               '--line', str(srv.get('line', 'default')),
+               '--engines', str(int(srv.get('engines', 1))),
+               '--max-clients', str(int(srv.get('max_clients', 64))),
+               '--drain-timeout', str(float(srv.get('drain_timeout', 30.0))),
+               '--resolver', '127.0.0.1:%d' % resolver.port,
+               '--replica', name,
+               '--heartbeat', str(float(flt.get('heartbeat_interval', 2.0)))]
+        if inf.get('batch_wait_ms') is not None:
+            cmd += ['--wait-ms', str(float(inf['batch_wait_ms']))]
+        if inf.get('max_batch') is not None:
+            cmd += ['--max-batch', str(int(inf['max_batch']))]
+        return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                cwd=os.getcwd())
+
+    return spawn
+
+
+def resolver_main(args, argv=None):
+    """``main.py --serve-fleet``: resolver + N managed replicas until
+    SIGTERM/SIGINT, then a fleet-wide graceful drain (replicas answer
+    everything accepted and exit 75) and exit 75 ourselves. Prints one
+    JSON ``fleet_ready`` line once every initial replica is routable."""
+    sargs = dict(args['train_args'])
+    sargs['env'] = dict(args['env_args'])
+    srv = dict(sargs.get('serving') or {})
+    flt = dict(srv.get('fleet') or {})
+    n = int(flt.get('replicas', 2))
+
+    guard = PreemptionGuard().install()
+    resolver = ServiceResolver(sargs)
+    if n > 0 or bool(flt.get('autoscale', False)):
+        resolver.spawner = _replica_spawner(sargs, resolver)
+    resolver.start()
+    for _ in range(n):
+        resolver.admit_replica()
+    if n and not resolver.wait_routable(n, timeout=180.0):
+        _LOG.error('fleet: only %d/%d replicas registered in time',
+                   sum(1 for r in resolver.fleet_table()
+                       if r['state'] in _ROUTABLE), n)
+    print(json.dumps({'fleet_ready': {
+        'port': resolver.port, 'pid': os.getpid(), 'replicas': n,
+        'registry': os.path.abspath(resolver.registry_root),
+        'table': resolver.fleet_table()}}), flush=True)
+    try:
+        while not guard.requested():
+            time.sleep(0.2)
+        _LOG.warning('fleet: preemption signal received; draining the '
+                     'fleet')
+    finally:
+        resolver.stop(drain=True)
+        guard.uninstall()
+    if guard.fired:
+        raise SystemExit(PREEMPT_EXIT_CODE)

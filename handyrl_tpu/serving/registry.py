@@ -44,6 +44,7 @@ import time
 from typing import Any, Dict, Optional, Set, Tuple
 
 from .. import telemetry
+from ..fault import Backoff
 from ..utils import fs
 
 _LOG = telemetry.get_logger('registry')
@@ -51,13 +52,25 @@ _LOG = telemetry.get_logger('registry')
 MANIFEST_NAME = 'registry.json'
 MANIFEST_FORMAT = 1
 
+# default for the serving.lock_timeout knob: how long a mutation waits for
+# the cross-process manifest lock before failing loudly instead of hanging
+DEFAULT_LOCK_TIMEOUT = 10.0
+
 _m_publishes = telemetry.counter('registry_publishes_total')
 _m_promotes = telemetry.counter('registry_promotes_total')
 _m_rollbacks = telemetry.counter('registry_rollbacks_total')
+_m_lock_timeouts = telemetry.counter('registry_lock_timeouts_total')
 
 
 class RegistryError(RuntimeError):
     """A resolve/load against the registry cannot be satisfied."""
+
+
+class RegistryLockTimeout(RegistryError):
+    """The cross-process manifest lock could not be acquired within
+    ``serving.lock_timeout`` — a peer process is wedged while holding it.
+    Raised instead of blocking the caller (e.g. the learner's publish
+    hook) forever."""
 
 
 def parse_spec(spec: str) -> Tuple[str, str]:
@@ -78,8 +91,10 @@ def _empty_manifest() -> Dict[str, Any]:
 class ModelRegistry:
     """Versioned model lines over one atomic JSON manifest."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str,
+                 lock_timeout: float = DEFAULT_LOCK_TIMEOUT):
         self.root = os.path.abspath(root)
+        self.lock_timeout = float(lock_timeout)
         self._tlock = threading.RLock()
         # (st_mtime_ns, st_size) of the manifest the cache was parsed from;
         # both maps shared by resolve/mutate callers on any thread
@@ -127,6 +142,31 @@ class ModelRegistry:
             self._cache = manifest
             return manifest
 
+    def _flock(self, lock_fd: int):
+        """Acquire the cross-process manifest lock, non-blockingly with
+        jittered retries bounded by ``lock_timeout``: a peer that wedged
+        while holding the lock must surface as a loud
+        :class:`RegistryLockTimeout`, not hang the caller forever."""
+        try:
+            import fcntl
+        except ImportError:           # non-POSIX: thread lock only
+            return
+        backoff = Backoff(initial=0.02, maximum=0.5, jitter=0.5)
+        deadline = time.monotonic() + self.lock_timeout
+        while True:
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _m_lock_timeouts.inc()
+                    raise RegistryLockTimeout(
+                        'could not acquire the registry manifest lock under '
+                        '%s within %.1fs — a peer process is wedged while '
+                        'holding it' % (self.root, self.lock_timeout))
+                time.sleep(min(backoff.next_delay(), remaining))
+
     def _mutate(self, fn) -> Any:
         """Serialized read-modify-write of the manifest: thread lock +
         cross-process ``flock`` on a sidecar lock file, fresh re-read under
@@ -137,11 +177,7 @@ class ModelRegistry:
             lock_fd = os.open(os.path.join(self.root, '.registry.lock'),
                               os.O_CREAT | os.O_RDWR, 0o644)
             try:
-                try:
-                    import fcntl
-                    fcntl.flock(lock_fd, fcntl.LOCK_EX)
-                except ImportError:   # non-POSIX: thread lock only
-                    pass
+                self._flock(lock_fd)
                 self._cache_stamp = None          # force a fresh read
                 manifest = self._read()
                 out = fn(manifest)

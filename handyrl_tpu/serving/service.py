@@ -44,17 +44,34 @@ import os
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from .. import telemetry
 from ..connection import (FramedConnection, Hub, open_socket_connection,
-                          is_infer)
+                          connect_socket_connection, is_infer)
 from ..connection import INFER_KIND
+from ..fault import Backoff
 from ..guard import PREEMPT_EXIT_CODE, PreemptionGuard
-from .client import SERVE_KIND, is_serve
+from .client import SERVE_KIND, is_serve, parse_endpoint
 from .registry import ModelRegistry, RegistryError, parse_spec
 
 _LOG = telemetry.get_logger('serving')
+
+
+class _WarmSink:
+    """Reply endpoint for synthetic warm-up requests (the rolling-promote
+    walk): the engine's reply lands here instead of a client socket, so a
+    replica can materialize + compile a model version end-to-end before the
+    champion flips to it."""
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.reply: Dict[str, Any] = {}
+
+    def deliver(self, msg: Dict[str, Any]):
+        self.reply = msg or {}
+        self.done.set()
 
 
 class InferenceService:
@@ -82,7 +99,16 @@ class InferenceService:
         self.metrics_port = int(srv.get('metrics_port') or 0)
         root = srv.get('registry_dir') or args.get('model_dir', 'models')
         self.registry = registry if registry is not None \
-            else ModelRegistry(root)
+            else ModelRegistry(root,
+                               lock_timeout=float(srv.get('lock_timeout',
+                                                          10.0)))
+        flt = dict(srv.get('fleet') or {})
+        self.resolver_endpoint = str(flt.get('resolver') or '')
+        self.replica_name = str(flt.get('replica') or '')
+        self.advertise_host = str(flt.get('advertise') or '')
+        self.heartbeat_interval = max(0.05,
+                                      float(flt.get('heartbeat_interval',
+                                                    2.0)))
 
         env = None
         self._example_obs = None
@@ -100,7 +126,10 @@ class InferenceService:
         # (endpoint id, rid) -> (t0, model label, client label); written at
         # submit (dispatch thread), popped at reply (engine threads)
         self._pending: Dict[Tuple[int, Any], tuple] = {}  # guarded-by: _lock
+        # recent request latencies (s) feeding the heartbeat SLO snapshot
+        self._lat_ring: deque = deque(maxlen=512)         # guarded-by: _lock
         self._draining = False
+        self._fleet_drain = False   # resolver told us to drain (autoscaler)
         self._stop = False
         self._sock: Optional[socket.socket] = None
         self.hub: Optional[Hub] = None
@@ -141,8 +170,11 @@ class InferenceService:
                 lambda: [telemetry.snapshot()], port=self.metrics_port
             ).start()
             self.metrics_port = self._exporter.port
-        for target, name in ((self._accept_loop, 'serve-accept'),
-                             (self._dispatch_loop, 'serve-dispatch')):
+        loops = [(self._accept_loop, 'serve-accept'),
+                 (self._dispatch_loop, 'serve-dispatch')]
+        if self.resolver_endpoint:
+            loops.append((self._fleet_loop, 'serve-heartbeat'))
+        for target, name in loops:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -317,9 +349,14 @@ class InferenceService:
 
     def _reply(self, ep, msg: Dict[str, Any]):
         """Engine reply fan-in: close the latency span, count, forward."""
+        if isinstance(ep, _WarmSink):
+            ep.deliver(msg)           # synthetic warm-up: no client socket
+            return
         with self._lock:
             entry = self._pending.pop((id(ep), (msg or {}).get('rid')), None)
             self._m_inflight.set(len(self._pending))
+            if entry is not None:
+                self._lat_ring.append(time.monotonic() - entry[0])
         if entry is not None:
             t0, model_label, client_label = entry
             self._m_latency(model_label, client_label).observe(
@@ -346,9 +383,151 @@ class InferenceService:
                                     'architecture': meta.get('architecture')}))
             except (RegistryError, ValueError) as exc:
                 self.hub.send(ep, (SERVE_KIND, {'error': str(exc)}))
+        elif op == 'warm':
+            self._warm(ep, str(body.get('model')))
         else:
             self.hub.send(ep, (SERVE_KIND,
                                {'error': 'unknown admin op %r' % (op,)}))
+
+    def _warm(self, ep, spec: str):
+        """Rolling-promote walk: materialize + compile ``line@selector``
+        end-to-end by pushing one synthetic request (the example
+        observation) through the engine, replying asynchronously — engine
+        compiles must not wedge the dispatch loop."""
+        if self._draining:
+            self.hub.send(ep, (SERVE_KIND, {'error': 'service draining'}))
+            return
+        try:
+            line, selector = parse_spec(spec)
+            version, _meta = self.registry.resolve(line, selector)
+        except (RegistryError, ValueError) as exc:
+            self.hub.send(ep, (SERVE_KIND, {'error': str(exc)}))
+            return
+        if self._example_obs is None:
+            # no env block: nothing to push through the engine; resolving
+            # (and the CRC-verified load on first real request) is all we
+            # can pre-pay
+            self.hub.send(ep, (SERVE_KIND, {'ok': True, 'line': line,
+                                            'version': version,
+                                            'warmed': False}))
+            return
+        handle = self._intern(line, version)
+
+        def run():
+            sink = _WarmSink()
+            self.engines[handle % len(self.engines)].submit(
+                sink, {'rid': -1, 'mid': handle, 'obs': self._example_obs})
+            ok = sink.done.wait(timeout=60.0)
+            err = (sink.reply.get('error') if ok
+                   else 'warm-up request timed out')
+            reply = ({'ok': True, 'line': line, 'version': version,
+                      'warmed': True} if ok and not err
+                     else {'error': str(err)})
+            self.hub.send(ep, (SERVE_KIND, reply))
+
+        t = threading.Thread(target=run, name='serve-warm', daemon=True)
+        t.start()
+
+    # -- fleet membership --------------------------------------------------
+
+    def fleet_drain_requested(self) -> bool:
+        """True once the resolver directed this replica to drain (the
+        autoscaler's scale-down path); ``serve_main`` then exits 75, the
+        same supervisor contract as a SIGTERM drain."""
+        return self._fleet_drain
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The live SLO numbers a heartbeat carries: recent p50/p99
+        latency, shed + request counters, in-flight depth."""
+        with self._lock:
+            lats = sorted(self._lat_ring)
+            inflight = len(self._pending)
+
+        def pct(q: float) -> float:
+            if not lats:
+                return 0.0
+            return 1e3 * lats[int(round((len(lats) - 1) * q))]
+
+        return {'p50_ms': pct(0.50), 'p99_ms': pct(0.99),
+                'inflight': inflight,
+                'shed': self.refused + sum(e.sheds for e in self.engines),
+                'received': self.received, 'answered': self.answered,
+                'draining': self._draining}
+
+    def _fleet_reply(self, conn, timeout: float = 5.0) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not conn.poll(remaining):
+                raise TimeoutError('no resolver reply within %.1fs'
+                                   % timeout)
+            msg = conn.recv()
+            if is_serve(msg) and isinstance(msg[1], dict):
+                return msg[1]
+
+    def _fleet_loop(self):
+        """Register with the resolver, then heartbeat liveness + the SLO
+        snapshot every ``heartbeat_interval``; a lost resolver is redialed
+        with jittered backoff (re-registration under the same replica name
+        is how a respawned replica is re-admitted). The heartbeat reply may
+        carry a drain directive."""
+        host, port = parse_endpoint(self.resolver_endpoint)
+        advertise = self.advertise_host or self.host or '127.0.0.1'
+        backoff = Backoff(initial=0.5, maximum=10.0)
+        conn = None
+        while not self._stop:
+            try:
+                if conn is None:
+                    conn = connect_socket_connection(host, port)
+                    body = {'op': 'register',
+                            'endpoint': '%s:%d' % (advertise, self.port),
+                            'pid': os.getpid()}
+                    if self.replica_name:
+                        body['replica'] = self.replica_name
+                    conn.send((SERVE_KIND, body))
+                    rep = self._fleet_reply(conn)
+                    if rep.get('error'):
+                        raise RuntimeError(str(rep['error']))
+                    self.replica_name = str(rep.get('replica')
+                                            or self.replica_name)
+                    backoff.reset()
+                    _LOG.info('serving: registered with resolver %s as '
+                              'replica %r', self.resolver_endpoint,
+                              self.replica_name)
+                conn.send((SERVE_KIND, {'op': 'heartbeat',
+                                        'replica': self.replica_name,
+                                        'slo': self.slo_snapshot()}))
+                rep = self._fleet_reply(conn)
+                if rep.get('drain') and not self._draining:
+                    _LOG.warning('serving: resolver directed replica %r to '
+                                 'drain', self.replica_name)
+                    self._fleet_drain = True
+                    self.request_drain()
+            except (OSError, ConnectionError, EOFError, ValueError,
+                    TimeoutError, RuntimeError) as exc:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    conn = None
+                if not self._stop:
+                    _LOG.warning('serving: resolver connection lost (%s: '
+                                 '%s); redialing', type(exc).__name__,
+                                 str(exc)[:200])
+                self._sleep(backoff.next_delay())
+                continue
+            self._sleep(self.heartbeat_interval)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _sleep(self, seconds: float):
+        deadline = time.monotonic() + seconds
+        while not self._stop and time.monotonic() < deadline:
+            time.sleep(min(0.1, max(0.0, deadline - time.monotonic())))
 
     # -- introspection -----------------------------------------------------
 
@@ -370,6 +549,8 @@ class InferenceService:
             'shed': shed,
             'draining': self._draining,
             'engines': len(self.engines),
+            'replica': self.replica_name,
+            'resolver': self.resolver_endpoint,
             'engine_requests': sum(e.requests_served for e in self.engines),
             'engine_batches': sum(e.batches_run for e in self.engines),
             'lines': {line: {'champion': entry['champion'],
@@ -401,11 +582,14 @@ def serve_main(args, argv=None):
         'port': service.port, 'metrics_port': service.metrics_port,
         'pid': os.getpid(), 'registry': service.registry.root}}), flush=True)
     try:
-        while not guard.requested():
+        while not guard.requested() and not service.fleet_drain_requested():
             time.sleep(0.2)
-        _LOG.warning('serving: preemption signal received; draining')
+        if guard.requested():
+            _LOG.warning('serving: preemption signal received; draining')
     finally:
         service.stop(drain=True)
         guard.uninstall()
-    if guard.fired:
+    if guard.fired or service.fleet_drain_requested():
+        # a resolver-directed drain exits through the same supervisor
+        # contract as a SIGTERM: 75 = done cleanly, restartable
         raise SystemExit(PREEMPT_EXIT_CODE)

@@ -473,8 +473,10 @@ COMPILE_SECOND_BUCKETS: Tuple[float, ...] = (0.01, 0.05, 0.1, 0.25, 0.5, 1,
 
 # Numeric encoding of the fleet controller's host health states
 # (fault.FleetController) for the per-host ``fleet_host_state`` gauge
-# family: monotone in severity, so operators can alert on `value >= 2`
-# (draining or quarantined = the host is not receiving fresh work).
+# family and the serving fleet's per-replica ``fleet_replica_state``
+# gauges: monotone in severity, so operators can alert on `value >= 2`
+# (draining or quarantined = the host/replica is not receiving fresh
+# work). The serving resolver additionally uses -1 for a retired replica.
 HOST_STATE_CODES: Dict[str, int] = {
     'healthy': 0, 'degraded': 1, 'draining': 2, 'quarantined': 3}
 

@@ -320,12 +320,17 @@ class Gather:
         self._rpc_lock = threading.RLock()
 
         self.engine: Optional[EngineSupervisor] = None
-        remote_endpoint = (args.get('serving') or {}).get('endpoint')
+        srv = args.get('serving') or {}
+        # remote mode engages on an explicit endpoint list OR a fleet
+        # resolver (the EngineClient fetches the replica table itself)
+        remote_endpoint = srv.get('endpoint') \
+            or (srv.get('fleet') or {}).get('resolver')
         if (args.get('inference') or {}).get('enabled') and remote_endpoint:
             # remote-service mode (docs/serving.md): workers dial the
-            # standalone InferenceService directly (EngineClient owns the
-            # link + failover), so this relay spawns no engine of its own —
-            # the 'model' RPC path stays available for degraded workers
+            # standalone InferenceService (or fleet) directly (EngineClient
+            # owns the links + replica failover), so this relay spawns no
+            # engine of its own — the 'model' RPC path stays available for
+            # degraded workers
             _LOG.info('gather %d: inference routed to remote service %s; '
                       'no local engine', gather_id, remote_endpoint)
         elif (args.get('inference') or {}).get('enabled'):

@@ -16,6 +16,9 @@ modes:
   --eval-client, -ec   network battle client MODEL_PATH [HOST]
   --serve, -sv         standalone model-serving tier (registry-versioned
                        inference service; SIGTERM drains and exits 75)
+  --serve-fleet, -sf   replicated serving fleet: resolver/router +
+                       serving.fleet.replicas managed replicas (SLO-driven
+                       autoscaling, zero-loss failover, rolling promotes)
 """
 
 
@@ -61,6 +64,9 @@ def main():
     elif mode in ('--serve', '-sv'):
         from handyrl_tpu.serving.service import serve_main
         serve_main(args, rest)
+    elif mode in ('--serve-fleet', '-sf'):
+        from handyrl_tpu.serving.fleet import resolver_main
+        resolver_main(args, rest)
     else:
         print('Not found mode %s.' % mode)
         print(USAGE)

@@ -34,6 +34,9 @@ def main() -> int:
         # generous coalescing window: the smoke asserts batching works, not
         # that it is fast, and CI boxes schedule client threads erratically
         'BENCH_SERVE_WAIT_MS': '20',
+        # single-service contract only: the fleet phase has its own leg
+        # (scripts/fleet_smoke.py) with chaos assertions
+        'BENCH_SERVE_REPLICAS': '0',
         'BENCH_DEADLINE_SEC': env.get('BENCH_DEADLINE_SEC', '540'),
     })
     proc = subprocess.run([sys.executable, os.path.join(REPO, 'bench.py')],

@@ -417,6 +417,49 @@ def test_baseline_entry_without_reason_is_a_config_error(tmp_path):
     assert errors and 'missing reason' in errors[0]
 
 
+def test_placeholder_pragma_reason_flagged_for_strict():
+    """A pragma whose reason is still the --write-baseline scaffold
+    placeholder suppresses the finding (non-strict stays green) but is
+    reported in placeholder_reasons — the --strict CI gate fails it until
+    a human justifies the exemption."""
+    from handyrl_tpu.analysis.core import PLACEHOLDER_REASON
+    dirty = '''
+import random
+
+def f(xs):
+    return random.choice(xs)  # graftlint: allow[GL001] %s
+''' % PLACEHOLDER_REASON
+    src = _src('handyrl_tpu/generation.py', dirty)
+    result = apply_suppressions(check_gl001(src), {src.path: src}, [])
+    assert result.findings == [] and result.pragma_errors == []
+    assert len(result.suppressed) == 1
+    assert len(result.placeholder_reasons) == 1
+    assert 'scaffold placeholder' in result.placeholder_reasons[0]
+
+
+def test_placeholder_baseline_reason_flagged_for_strict(tmp_path):
+    from handyrl_tpu.analysis.core import PLACEHOLDER_REASON
+    dirty = 'import random\n\ndef f(xs):\n    return random.choice(xs)\n'
+    src = _src('handyrl_tpu/generation.py', dirty)
+    findings = check_gl001(src)
+    bl = tmp_path / 'baseline.json'
+    bl.write_text(json.dumps([
+        {'rule': 'GL001', 'path': 'handyrl_tpu/generation.py',
+         'context': 'return random.choice(xs)',
+         'reason': PLACEHOLDER_REASON}]))
+    entries, errors = load_baseline(str(bl))
+    assert errors == []                        # a reason IS present…
+    result = apply_suppressions(findings, {src.path: src}, entries)
+    assert result.findings == []               # …and it still suppresses
+    assert len(result.baselined) == 1
+    assert len(result.placeholder_reasons) == 1   # …but strict fails it
+    assert 'scaffold placeholder' in result.placeholder_reasons[0]
+    # an UNUSED placeholder entry is stale, not placeholder-flagged twice
+    result2 = apply_suppressions([], {src.path: src}, entries)
+    assert result2.placeholder_reasons == []
+    assert len(result2.stale_baseline) == 1
+
+
 # ---------------------------------------------------------------------------
 # the CI gate on the real tree
 

@@ -575,3 +575,441 @@ def test_service_sigterm_drains_and_exits_75(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+# ---------------------------------------------------------------------------
+# registry lock timeout (serving.lock_timeout satellite)
+
+
+def test_registry_lock_timeout_raises_loudly(tmp_path):
+    """A peer wedged while holding the manifest flock must surface as
+    RegistryLockTimeout after serving.lock_timeout, not a silent hang."""
+    import fcntl
+    from handyrl_tpu.serving.registry import RegistryLockTimeout
+    reg = ModelRegistry(str(tmp_path), lock_timeout=0.4)
+    reg.publish('l', snapshot={'architecture': 'X', 'params': b'AAAA'},
+                version=1, promote=True)
+    fd = os.open(os.path.join(str(tmp_path), '.registry.lock'),
+                 os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX)        # the wedged peer
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RegistryLockTimeout):
+            reg.promote('l', 1)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        os.close(fd)
+    # lock released: the same mutation goes through
+    reg.promote('l', 1)
+
+
+# ---------------------------------------------------------------------------
+# ServiceClient transport-failure semantics (dial retry satellite)
+
+
+def test_service_client_dead_endpoint_raises_unavailable():
+    from handyrl_tpu.serving.client import ServiceUnavailable
+    port = _free_port()
+    t0 = time.monotonic()
+    with pytest.raises(ServiceUnavailable):
+        ServiceClient('localhost', port, dial_retries=1, dial_backoff=0.05)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_service_client_severed_socket_raises_unavailable(tmp_path):
+    """A socket that dies mid-wait surfaces as ServiceUnavailable (the
+    retryable transport error), never a raw OSError and never a
+    ServiceError (which means the service ANSWERED with an error)."""
+    from handyrl_tpu.serving.client import ServiceUnavailable
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    svc = InferenceService(_service_args(str(tmp_path))).start()
+    from tests.proxy import ChaosProxy
+    proxy = ChaosProxy(target_port=svc.port)
+    try:
+        client = ServiceClient('127.0.0.1', proxy.port, timeout=10.0,
+                               dial_retries=0)
+        client.request('default@champion', obs, legal=legal,
+                       seed=sample_seed(1, (0, 0), 0))
+        rid = client.submit('default@champion', obs, legal=legal,
+                            seed=sample_seed(1, (0, 1), 0))
+        proxy.accepting = False    # a racing accept closes the socket
+        proxy.blackhole = True     # …and anything accepted goes mute
+        proxy.close()              # live sockets severed mid-wait
+        with pytest.raises(ServiceUnavailable):
+            client.collect(rid, timeout=10)
+        # the next submit redials into the half-dead proxy (its pinned
+        # listener backlog still completes handshakes — a blackhole): the
+        # deadline surfaces as the OTHER retryable shape, never raw OSError
+        rid2 = client.submit('default@champion', obs, legal=legal,
+                             seed=sample_seed(1, (0, 2), 0))
+        with pytest.raises((ServiceUnavailable, TimeoutError)):
+            client.collect(rid2, timeout=1.0)
+        client.close()
+        # the failure was transport-scoped: the live service still answers
+        direct = ServiceClient('127.0.0.1', svc.port)
+        direct.request('default@champion', obs, legal=legal,
+                       seed=sample_seed(1, (0, 3), 0))
+        direct.close()
+    finally:
+        proxy.close()
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet: breaker + autoscaler units (pure, fake clocks)
+
+
+def test_replica_breaker_open_halfopen_close():
+    from handyrl_tpu.serving.fleet import ReplicaBreaker
+    now = [100.0]
+    b = ReplicaBreaker(initial=1.0, maximum=8.0, clock=lambda: now[0],
+                       rng=__import__('random').Random(0))
+    assert b.admits() and b.state == 'closed'
+    assert b.record_failure() is True          # newly opened
+    assert b.state == 'open' and not b.admits()
+    now[0] += 2.5                              # past the jittered delay
+    assert b.admits()                          # half-open probe due
+    b.begin_probe()
+    assert not b.admits()                      # ONE probe in flight
+    assert b.record_failure() is False         # re-open, not newly opened
+    now[0] += 20.0
+    assert b.admits()
+    b.begin_probe()
+    b.record_success()
+    assert b.state == 'closed' and b.admits()
+
+
+def test_autoscaler_policy_admit_and_drain():
+    from handyrl_tpu.serving.fleet import AutoscalerPolicy
+    now = [0.0]
+    pol = AutoscalerPolicy(slo_p99_ms=50.0, breach_window=10.0,
+                           idle_window=30.0, min_replicas=1, max_replicas=3,
+                           clock=lambda: now[0])
+
+    def table(p99, inflight, n=2, shed=0):
+        return [{'replica': 'r%d' % i, 'state': 'healthy', 'p99_ms': p99,
+                 'inflight': inflight, 'shed': shed} for i in range(n)]
+
+    # sustained p99 breach -> admit (only after breach_window)
+    assert pol.decide(table(80.0, 4)) is None
+    now[0] = 5.0
+    assert pol.decide(table(80.0, 4)) is None
+    now[0] = 11.0
+    assert pol.decide(table(80.0, 4)) == 'admit'
+    # at max_replicas no admit fires even under breach
+    now[0] = 30.0
+    pol.decide(table(80.0, 4, n=3))
+    now[0] = 45.0
+    assert pol.decide(table(80.0, 4, n=3)) is None
+    # recovery resets the breach timer; sustained idleness -> drain
+    now[0] = 50.0
+    assert pol.decide(table(10.0, 0)) is None
+    now[0] = 79.0
+    assert pol.decide(table(10.0, 0)) is None
+    now[0] = 81.0
+    assert pol.decide(table(10.0, 0)) == 'drain'
+    # at min_replicas idleness never drains
+    now[0] = 120.0
+    pol.decide(table(10.0, 0, n=1))
+    now[0] = 160.0
+    assert pol.decide(table(10.0, 0, n=1)) is None
+    # a growing shed counter is a breach even under the p99 target
+    now[0] = 200.0
+    pol.decide(table(10.0, 1, shed=5))
+    now[0] = 201.0
+    pol.decide(table(10.0, 1, shed=9))
+    now[0] = 212.0
+    assert pol.decide(table(10.0, 1, shed=12)) == 'admit'
+
+
+# ---------------------------------------------------------------------------
+# fleet: resolver + routed client (in-process)
+
+
+def _fleet_args(root, resolver_port=None, **flt):
+    fleet = dict(flt)
+    if resolver_port is not None:
+        fleet['resolver'] = '127.0.0.1:%d' % resolver_port
+    return _service_args(str(root), fleet=fleet)
+
+
+@pytest.mark.timeout(300)
+def test_resolver_registration_heartbeat_and_quarantine_roundtrip(tmp_path):
+    """Replicas register + heartbeat; silence past heartbeat_timeout walks
+    the replica healthy -> draining -> quarantined; a re-registration under
+    the same name re-admits it to healthy."""
+    from handyrl_tpu.serving.fleet import ServiceResolver
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_interval=0.1, heartbeat_timeout=0.6,
+        quarantine_period=60.0)).start()
+    admin = ServiceClient('127.0.0.1', resolver.port, name='ops')
+    try:
+        rep = admin._call_admin({'op': 'register',
+                                 'endpoint': '127.0.0.1:12345', 'pid': 1})
+        name = rep['replica']
+        assert rep['ok'] and name == 'r0'
+        # heartbeats keep it healthy
+        for _ in range(3):
+            beat = admin._call_admin({'op': 'heartbeat', 'replica': name,
+                                      'slo': {'p99_ms': 1.0, 'inflight': 0,
+                                              'shed': 0}})
+            assert beat['ok'] and beat['drain'] is False
+            time.sleep(0.1)
+        table = admin._call_admin({'op': 'fleet'})
+        assert table['fleet'] is True
+        assert table['replicas'][0]['state'] == 'healthy'
+        # an unknown replica heartbeat is refused (register first)
+        bad = admin._call_admin({'op': 'heartbeat', 'replica': 'ghost'})
+        assert 'error' in bad
+        # silence: the resolver strands it within a few ticks
+        deadline = time.monotonic() + 20
+        state = 'healthy'
+        while time.monotonic() < deadline:
+            rows = admin._call_admin({'op': 'fleet'})['replicas']
+            state = rows[0]['state']
+            if state == 'quarantined':
+                break
+            time.sleep(0.1)
+        assert state == 'quarantined'
+        # re-registration under the same name (a respawn) re-admits it
+        rep2 = admin._call_admin({'op': 'register', 'replica': name,
+                                  'endpoint': '127.0.0.1:12346', 'pid': 2})
+        assert rep2['ok'] and rep2['replica'] == name
+        rows = admin._call_admin({'op': 'fleet'})['replicas']
+        assert rows[0]['state'] == 'healthy'
+        assert rows[0]['endpoint'] == '127.0.0.1:12346'
+        status = admin._call_admin({'op': 'status'})
+        assert status['resolver'] is True
+        assert status['controller']['readmitted'] >= 1
+    finally:
+        admin.close()
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_routed_client_chaos_failover_byte_identical(tmp_path):
+    """The zero-loss chaos contract: one replica dies mid-burst (severed
+    sockets + refused redials); every in-flight request is transparently
+    replayed on the surviving replica and every reply stays byte-identical
+    to the local reference — callers never see the failure."""
+    from handyrl_tpu.serving.fleet import RoutedClient, ServiceResolver
+    from tests.proxy import ChaosProxy
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_timeout=60.0)).start()
+    svc_a = InferenceService(_service_args(str(tmp_path))).start()
+    svc_b = InferenceService(_service_args(str(tmp_path))).start()
+    proxy = ChaosProxy(target_port=svc_a.port)     # a dies through this
+    admin = ServiceClient('127.0.0.1', resolver.port, name='ops')
+    admin._call_admin({'op': 'register', 'replica': 'a',
+                       'endpoint': '127.0.0.1:%d' % proxy.port, 'pid': 0})
+    admin._call_admin({'op': 'register', 'replica': 'b',
+                       'endpoint': '127.0.0.1:%d' % svc_b.port, 'pid': 0})
+    rc = RoutedClient('127.0.0.1', resolver.port, timeout=15.0,
+                      refresh_interval=0.2)
+    try:
+        refs, reps = [], []
+        for k in range(4):
+            seed = sample_seed(11, (0, k), 0)
+            refs.append((seed, model_act(w, obs, None, legal, seed)))
+            reps.append(rc.request('default@champion', obs, legal=legal,
+                                   seed=seed))
+        assert proxy.accepted > 0, 'round-robin never dialed replica a'
+        for (_, ref), rep in zip(refs, reps):
+            assert rep['action'] == ref['action']
+            assert rep['prob'] == ref['prob']
+        # leave a burst in flight, then kill replica a hard
+        rids = [rc.submit('default@champion', obs, legal=legal, seed=s)
+                for s, _ in refs]
+        proxy.accepting = False
+        proxy.sever()
+        failures = 0
+        for rid, (_, ref) in zip(rids, refs):
+            rep = rc.collect(rid)          # replays ride replica b
+            if rep['action'] != ref['action'] or rep['prob'] != ref['prob']:
+                failures += 1
+            assert isinstance(rep['prob'], np.float32)
+        assert failures == 0, '%d non-identical replies' % failures
+        # and fresh requests keep flowing (breaker shields replica a)
+        for s, ref in refs:
+            rep = rc.request('default@champion', obs, legal=legal, seed=s)
+            assert rep['action'] == ref['action']
+            assert rep['prob'] == ref['prob']
+    finally:
+        rc.close()
+        admin.close()
+        proxy.close()
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_fleet_rolling_promote_warms_before_flip(tmp_path):
+    """A rolling promote warms every routable replica (the warm admin op
+    materializes + compiles the candidate) BEFORE the champion flips, and
+    requests against @champion follow the flip."""
+    from handyrl_tpu.serving.fleet import RoutedClient, ServiceResolver
+    env, w1 = _ttt_wrapper(seed=7)
+    _, w2 = _ttt_wrapper(seed=19)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w1.snapshot(), version=1, promote=True)
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_timeout=60.0)).start()
+
+    def replica():
+        return InferenceService(_fleet_args(
+            tmp_path, resolver_port=resolver.port,
+            heartbeat_interval=0.1)).start()
+
+    svc_a, svc_b = replica(), replica()
+    assert resolver.wait_routable(2, timeout=30)
+    rc = RoutedClient('127.0.0.1', resolver.port, timeout=15.0,
+                      refresh_interval=0.2)
+    try:
+        seed = sample_seed(11, (0, 2), 0)
+        ref1 = model_act(w1, obs, None, legal, seed)
+        rep = rc.request('default@champion', obs, legal=legal, seed=seed)
+        assert rep['prob'] == ref1['prob']
+        reg.publish('default', snapshot=w2.snapshot(), version=2)
+        out = rc.promote('default@2', timeout=120)
+        assert out.get('ok'), out
+        assert sorted(out['warmed']) == ['r0', 'r1']
+        assert ModelRegistry(str(tmp_path)).resolve('default',
+                                                    'champion')[0] == '2'
+        ref2 = model_act(w2, obs, None, legal, seed)
+        for _ in range(4):   # both replicas now serve v2 as champion
+            rep = rc.request('default@champion', obs, legal=legal,
+                             seed=seed)
+            assert rep['prob'] == ref2['prob']
+    finally:
+        rc.close()
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_engine_client_rotates_across_replica_endpoints(tmp_path):
+    """The worker EngineClient with a comma-separated endpoint list stays
+    on the ENGINE path when one replica dies: the dead endpoint down-marks
+    and the next dial rotates to the survivor (no local degradation)."""
+    from handyrl_tpu.inference import RemoteModel
+    env, w = _ttt_wrapper(seed=7)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=5, promote=True)
+    svc_a = InferenceService(_service_args(str(tmp_path))).start()
+    svc_b = InferenceService(_service_args(str(tmp_path))).start()
+    try:
+        # retries=1: a timed-out endpoint down-marks and the resend
+        # rotates — an in-process stop() leaves sockets half-open (a
+        # blackhole), unlike a real crash's RST
+        remote = RemoteModel(_remote_client(
+            'localhost:%d,localhost:%d' % (svc_a.port, svc_b.port),
+            w.snapshot(), request_timeout=3.0, request_retries=1), 5)
+        seed = sample_seed(11, (0, 4), 0)
+        ref = model_act(w, obs, None, legal, seed)
+        for _ in range(3):
+            rep = remote.act(obs, None, legal, seed)
+            assert rep['prob'] == ref['prob']
+        assert remote.client.engine_ok
+        svc_a.stop(drain=False)     # first replica gone
+        for _ in range(6):
+            rep = remote.act(obs, None, legal, seed)
+            assert rep['prob'] == ref['prob']
+        # the survivor kept the circuit closed: no local failover happened
+        assert remote.client.engine_ok, \
+            'client degraded locally despite a live replica'
+    finally:
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# fleet: SIGKILL zero-loss e2e (subprocess resolver + managed replicas)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_fleet_sigkill_zero_loss_and_respawn(tmp_path):
+    """The acceptance chaos run: a 2-replica managed fleet under client
+    load; one replica is SIGKILLed mid-burst. Zero client-visible
+    failures, byte-identical replayed replies, the resolver logs the
+    healthy -> quarantined -> healthy round trip (respawn re-registers
+    under the old name), and SIGTERM drains the fleet to exit 75."""
+    from handyrl_tpu.serving.fleet import RoutedClient
+    env, w = _ttt_wrapper(seed=7)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'handyrl_tpu.serving', '--fleet',
+         '--replicas', '2', '--env', 'TicTacToe', '--registry',
+         str(tmp_path), '--port', '0', '--line', 'default',
+         '--heartbeat', '0.2', '--heartbeat-timeout', '2.0'],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    rc = None
+    try:
+        ready = json.loads(proc.stdout.readline())['fleet_ready']
+        assert ready['replicas'] == 2
+        rc = RoutedClient('127.0.0.1', int(ready['port']), timeout=20.0,
+                          refresh_interval=0.2)
+        table = {r['replica']: r for r in rc.replicas()}
+        assert len(table) == 2
+        seeds = [sample_seed(11, (0, k), 0) for k in range(6)]
+        refs = [model_act(w, obs, None, legal, s) for s in seeds]
+        for s, ref in zip(seeds, refs):
+            rep = rc.request('default@champion', obs, legal=legal, seed=s)
+            assert rep['prob'] == ref['prob']
+        # SIGKILL one replica with a burst in flight
+        rids = [rc.submit('default@champion', obs, legal=legal, seed=s)
+                for s in seeds]
+        victim = sorted(table)[0]
+        os.kill(table[victim]['pid'], signal.SIGKILL)
+        failures = 0
+        for rid, ref in zip(rids, refs):
+            rep = rc.collect(rid)
+            if rep['action'] != ref['action'] or rep['prob'] != ref['prob']:
+                failures += 1
+        assert failures == 0, '%d client-visible failures' % failures
+        # the resolver strands the corpse (healthy -> draining ->
+        # quarantined), respawns it under its old name, and the
+        # re-registration re-admits it to healthy: the 'readmitted'
+        # controller counter only moves on that non-healthy -> healthy
+        # round trip, so it can't be missed between table polls
+        round_trip = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            states = {r['replica']: r['state'] for r in rc.replicas()}
+            readmitted = rc.status()['controller'].get('readmitted', 0)
+            if readmitted >= 1 and states.get(victim) == 'healthy':
+                round_trip = True
+                break
+            time.sleep(0.25)
+        assert round_trip, \
+            'kill never walked the quarantine round trip: %s' % states
+        # the respawned replica serves byte-identical replies again
+        for s, ref in zip(seeds, refs):
+            rep = rc.request('default@champion', obs, legal=legal, seed=s)
+            assert rep['prob'] == ref['prob']
+        # fleet-wide graceful drain: exit 75 (EX_TEMPFAIL, restart me)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 75
+    finally:
+        if rc is not None:
+            rc.close()
+        if proc.poll() is None:
+            proc.kill()
