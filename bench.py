@@ -110,6 +110,23 @@ def _active_mode() -> str:
     return os.environ.get('BENCH_MODE', 'headline').strip().lower()
 
 
+def _git_sha() -> str:
+    """The repo HEAD sha stamped on every row, so the benchmarks.jsonl
+    trajectory can be diffed across commits ('' outside a git checkout)."""
+    try:
+        out = subprocess.run(
+            ['git', 'rev-parse', 'HEAD'], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() if out.returncode == 0 else ''
+    except Exception:
+        return ''
+
+
+# bump when the emitted row shape changes incompatibly (keys renamed or
+# re-typed) — consumers filter rows by this before diffing trajectories
+BENCH_SCHEMA_VERSION = 2
+
+
 def emit(value=0.0, vs_baseline=0.0, **extra):
     """Print the one JSON result line (at most once) and flush."""
     global _EMITTED
@@ -122,7 +139,8 @@ def emit(value=0.0, vs_baseline=0.0, **extra):
                     'serve': (SERVE_METRIC, SERVE_UNIT)}.get(
                         _active_mode(), (METRIC, UNIT))
     line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
-            'vs_baseline': round(float(vs_baseline), 2)}
+            'vs_baseline': round(float(vs_baseline), 2),
+            'git_sha': _git_sha(), 'schema_version': BENCH_SCHEMA_VERSION}
     line.update(extra)
     print(json.dumps(line), flush=True)
 
@@ -469,6 +487,31 @@ def run_ingest(probe: dict):
         finally:
             telemetry.configure_tracing('', None, force=True)
             shutil.rmtree(trace_dir, ignore_errors=True)
+        # recorder-on vs recorder-off pair: the flight recorder defaults on
+        # (an operator kills it with the rest of the plane via
+        # `telemetry: false`); this adjacent A/B toggles ONLY the ring so
+        # its append cost is isolated from metric/span cost — both legs run
+        # back to back against identical warmed caches
+        # alternating long legs, best-of-5 per side: the ring cost is far
+        # below the run-to-run noise of a short timed pass (scheduler
+        # stalls only ever slow a leg down), so max throughput per side is
+        # the robust capability estimate and a one-shot pair would report
+        # noise with either sign
+        rounds = []
+        for _ in range(5):
+            on = _measure_ingest(make_batch, episodes, args, n_batches * 5)
+            telemetry.set_recorder_enabled(False)
+            try:
+                off = _measure_ingest(make_batch, episodes, args,
+                                      n_batches * 5)
+            finally:
+                telemetry.set_recorder_enabled(True)
+            rounds.append((on, off))
+        recorder_on_bps = max(on for on, _ in rounds)
+        recorder_off_bps = max(off for _, off in rounds)
+        recorder_overhead = (100.0 * (1.0 - recorder_on_bps /
+                                      recorder_off_bps)
+                             if recorder_off_bps else 0.0)
 
     default_geom = (B == 128 and T == 16)
     # stage keys in the canonical telemetry order (telemetry.INGEST_STAGES
@@ -490,6 +533,9 @@ def run_ingest(probe: dict):
          tracing_overhead_pct=round(
              100.0 * (1.0 - traced_bps / new_bps), 2) if new_bps else 0.0,
          trace_sample_rate=trace_rate,
+         recorder_on_batches_per_sec=round(recorder_on_bps, 2),
+         recorder_off_batches_per_sec=round(recorder_off_bps, 2),
+         recorder_overhead_pct=round(recorder_overhead, 2),
          geometry=('headline' if default_geom else 'dryrun'))
 
 

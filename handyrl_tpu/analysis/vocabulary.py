@@ -110,6 +110,40 @@ def collect_code_vocabulary(sources: Dict[str, SourceFile]
     return metrics, stages
 
 
+def collect_alert_names(sources: Dict[str, SourceFile]
+                        ) -> Dict[str, Tuple[str, int]]:
+    """Alert-rule names: the builtin catalog (telemetry.BUILTIN_ALERTS
+    rule dicts' ``name`` values) plus ``name=`` literals handed to
+    AlertRule/alert-rule dict constructions at call sites. Each one is an
+    operator-facing identifier (``alerts_active{alert=}`` label values,
+    metrics_jsonl ``alerts.active`` entries) and must be documented."""
+    names: Dict[str, Tuple[str, int]] = {}
+    for path, src in sorted(sources.items()):
+        if not path.endswith('telemetry.py') \
+                or not path.startswith('handyrl_tpu/') \
+                or path.startswith(_EXCLUDED_PREFIXES):
+            continue
+        try:
+            tree = ast.parse(src.text)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) \
+                    or not any(isinstance(t, ast.Name)
+                               and t.id == 'BUILTIN_ALERTS'
+                               for t in node.targets):
+                continue
+            for elt in getattr(node.value, 'elts', []):
+                if not isinstance(elt, ast.Dict):
+                    continue
+                for k, v in zip(elt.keys, elt.values):
+                    if isinstance(k, ast.Constant) and k.value == 'name' \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        names.setdefault(v.value, (path, k.lineno))
+    return names
+
+
 # ---------------------------------------------------------------------------
 # docs parsing
 
@@ -266,6 +300,28 @@ def check_gl005(sources: Dict[str, SourceFile]) -> List[Finding]:
                 'GL005', line,
                 'stage %r is recorded here but missing from the '
                 'docs/observability.md stage glossary' % name))
+
+    # alert rules -> doc: every builtin alert name is an operator-facing
+    # identifier (alerts_active{alert=} label, metrics_jsonl alerts.active
+    # entry) and must appear in docs/observability.md
+    alerts = collect_alert_names(sources)
+    for name, (path, line) in sorted(alerts.items()):
+        if name not in doc_tokens:
+            src = sources[path]
+            out.append(src.finding(
+                'GL005', line,
+                'alert rule %r is defined here but has no row in the '
+                'docs/observability.md alert catalog' % name))
+
+    # doc -> alert rules: alert-catalog rows must name a real rule
+    alert_rows = _table_first_cells(
+        obs, lambda h: 'alert catalog' in h.lower())
+    for name in sorted(set(alert_rows)):
+        if name not in alerts and name not in source_blob:
+            out.append(obs.finding(
+                'GL005', _doc_line_of(obs, name),
+                'documented alert %r matches no rule in '
+                'telemetry.BUILTIN_ALERTS — stale doc row' % name))
 
     # doc -> code: catalog rows must correspond to something emitted
     def _in_code(name: str) -> bool:

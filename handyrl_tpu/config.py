@@ -160,6 +160,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
             'breach_window': 10.0,       # SLO breach must persist this long (s) before a replica is admitted
             'idle_window': 60.0,         # fleet must be fully idle this long (s) before a replica is drained
             'quarantine_period': 30.0,   # quarantine length (s) before a silent replica is speculatively re-admitted (a re-registration re-admits it immediately)
+            'metrics_port': 0,           # resolver-side Prometheus /metrics + /statusz port (0 = exporter off); the fleet's alert engine and replica-state view live here
         },
     },
 
@@ -286,13 +287,32 @@ def validate(args: Dict[str, Any]) -> None:
     tel = ta.get('telemetry', True)
     assert isinstance(tel, (bool, dict)), \
         'telemetry must be a bool or a block (enabled / trace_dir / ' \
-        'trace_sample_rate)'
+        'trace_sample_rate / blackbox_dir / recorder_events / ' \
+        'metrics_rotate_mb / alerts)'
     tel_enabled = bool(tel.get('enabled', True)) if isinstance(tel, dict) \
         else bool(tel)
     if isinstance(tel, dict):
         rate = float(tel.get('trace_sample_rate', 1.0))
         assert 0.0 <= rate <= 1.0, \
             'telemetry.trace_sample_rate must be a fraction in [0, 1]'
+        assert int(tel.get('recorder_events', 256)) >= 16, \
+            'telemetry.recorder_events must be >= 16 (the flight-recorder ' \
+            'ring needs room for a useful postmortem tail)'
+        assert float(tel.get('metrics_rotate_mb', 0)) >= 0, \
+            'telemetry.metrics_rotate_mb must be >= 0 (0 disables rotation)'
+        alerts = tel.get('alerts', {})
+        assert isinstance(alerts, (bool, dict, list)), \
+            'telemetry.alerts must be a block ({builtin, interval, rules}), ' \
+            'a rule list, or False'
+        if isinstance(alerts, dict) and alerts.get('interval') is not None:
+            assert float(alerts['interval']) > 0, \
+                'telemetry.alerts interval must be > 0 seconds'
+        rules = alerts.get('rules') if isinstance(alerts, dict) else \
+            (alerts if isinstance(alerts, list) else None)
+        for rule in (rules or []):
+            assert isinstance(rule, dict) and rule.get('name') \
+                and rule.get('metric'), \
+                'each telemetry.alerts rule needs at least name + metric'
     if ta.get('profile_epochs'):
         epochs = parse_epoch_set(ta['profile_epochs'])
         assert epochs and all(e >= 1 for e in epochs), \

@@ -165,6 +165,7 @@ class TaskLedger:
         self._reissue.append(base)
         self._strandings.append((endpoint, reason, self._clock()))
         self.stats['reissued'] += 1
+        telemetry.record_event('stranding', str(endpoint), reason=reason)
 
     def fail_endpoint(self, endpoint) -> int:
         """Re-queue every task booked against a detached endpoint."""
@@ -354,6 +355,8 @@ class FleetController:
             return
         self._state[host] = state
         self._transitions.append((host, prev, state, self._clock()))
+        telemetry.record_event('transition', host, **{
+            'from': prev, 'to': state})
 
     def _prune(self, host: str, now: float):
         horizon = now - self.health_window

@@ -1169,6 +1169,11 @@ class EngineSupervisor:
                 pass
         self.restarts += 1
         self._m_restarts[reason].inc()
+        telemetry.record_event('supervisor', 'engine %s declared' % reason,
+                               restarts=self.restarts,
+                               stranded=len(stranded))
+        telemetry.dump_blackbox('engine-' + reason, restarts=self.restarts,
+                                stranded=len(stranded))
         delay = self._backoff.next_delay()
         _LOG.warning('engine %s detected (progress %.1fs ago, %d request(s) '
                      'error-answered); restarting in %.1fs',

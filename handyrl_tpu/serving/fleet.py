@@ -197,6 +197,7 @@ class ServiceResolver:
         flt = dict(srv.get('fleet') or {})
         self.host = str(srv.get('host') or '')
         self.port = int(flt.get('port', 0))
+        self.metrics_port = int(flt.get('metrics_port') or 0)
         self.default_line = str(srv.get('line', 'default'))
         self.registry_root = str(srv.get('registry_dir')
                                  or args.get('model_dir', 'models'))
@@ -245,6 +246,12 @@ class ServiceResolver:
         self._m_respawns = telemetry.counter('fleet_respawns_total')
         self._m_promotes = telemetry.counter('fleet_rolling_promotes_total')
 
+        # resolver-side SLO alert engine (heartbeat misses, quarantine
+        # flap, shed burn over the merged heartbeat counters), driven from
+        # the tick loop and /statusz scrapes
+        self._alerts = telemetry.AlertEngine.from_config(args)
+        self._exporter = None
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> 'ServiceResolver':
@@ -259,6 +266,11 @@ class ServiceResolver:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.metrics_port and telemetry.enabled():
+            self._exporter = telemetry.TelemetryExporter(
+                lambda: [telemetry.snapshot()], port=self.metrics_port,
+                status=self._status_info).start()
+            self.metrics_port = self._exporter.port
         _LOG.info('fleet: resolver listening on port %d (registry %s)',
                   self.port, self.registry_root)
         return self
@@ -296,6 +308,9 @@ class ServiceResolver:
                 except Exception:
                     pass
         self._stop = True
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -467,6 +482,8 @@ class ServiceResolver:
             self._autoscale_step()
         self._supervise()
         self._journal()
+        if self._alerts is not None:
+            self._alerts.maybe_evaluate(lambda: [telemetry.snapshot()])
 
     def _autoscale_step(self):
         decision = self.policy.decide(self.fleet_table())
@@ -653,6 +670,22 @@ class ServiceResolver:
                 'warmed': warmed}
 
     # -- introspection -----------------------------------------------------
+
+    def _status_info(self) -> Dict[str, Any]:
+        """/statusz payload for the resolver metrics port: per-replica
+        states, the routable count, and the fleet-level alert state."""
+        with self._lock:
+            states = {n: self.controller.state(n) for n in self._replicas}
+        info: Dict[str, Any] = {
+            'fleet_replicas': states,
+            'progress': {'replicas': len(states),
+                         'routable': sum(1 for s in states.values()
+                                         if s in _ROUTABLE)},
+        }
+        if self._alerts is not None:
+            info['alerts'] = self._alerts.maybe_evaluate(
+                lambda: [telemetry.snapshot()])
+        return info
 
     def fleet_table(self) -> List[Dict[str, Any]]:
         """The replica table routers consume: name, endpoint, state, and
@@ -982,6 +1015,9 @@ def resolver_main(args, argv=None):
     flt = dict(srv.get('fleet') or {})
     n = int(flt.get('replicas', 2))
 
+    telemetry.adopt_config(sargs)
+    telemetry.set_process_label('fleet-resolver')
+    telemetry.install_crash_dump()
     guard = PreemptionGuard().install()
     resolver = ServiceResolver(sargs)
     if n > 0 or bool(flt.get('autoscale', False)):

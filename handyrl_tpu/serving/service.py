@@ -150,6 +150,10 @@ class InferenceService:
         self._m_clients = telemetry.gauge('serve_clients')
         self._m_inflight = telemetry.gauge('serve_inflight')
         self._m_draining = telemetry.gauge('serve_draining')
+        # SLO alert engine over this replica's own registry (shed burn
+        # rate, heartbeat misses); evaluated on /statusz scrapes and the
+        # heartbeat loop through one cadence-gated stream
+        self._alerts = telemetry.AlertEngine.from_config(args)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -167,7 +171,8 @@ class InferenceService:
             for _ in range(self.engines_n)]
         if self.metrics_port and telemetry.enabled():
             self._exporter = telemetry.TelemetryExporter(
-                lambda: [telemetry.snapshot()], port=self.metrics_port
+                lambda: [telemetry.snapshot()], port=self.metrics_port,
+                status=self._status_info
             ).start()
             self.metrics_port = self._exporter.port
         loops = [(self._accept_loop, 'serve-accept'),
@@ -436,6 +441,27 @@ class InferenceService:
         same supervisor contract as a SIGTERM drain."""
         return self._fleet_drain
 
+    def poll_alerts(self):
+        """Drive the alert engine from the owner's idle loop so rules
+        fire/clear even when nothing scrapes /statusz."""
+        if self._alerts is not None:
+            self._alerts.maybe_evaluate(lambda: [telemetry.snapshot()])
+
+    def _status_info(self) -> Dict[str, Any]:
+        """/statusz payload for the serving metrics port: live SLO
+        numbers, request progress, and the replica's alert state."""
+        info: Dict[str, Any] = {
+            'slo': self.slo_snapshot(),
+            'progress': {'received': self.received,
+                         'answered': self.answered,
+                         'refused': self.refused,
+                         'draining': bool(self._draining)},
+        }
+        if self._alerts is not None:
+            info['alerts'] = self._alerts.maybe_evaluate(
+                lambda: [telemetry.snapshot()])
+        return info
+
     def slo_snapshot(self) -> Dict[str, Any]:
         """The live SLO numbers a heartbeat carries: recent p50/p99
         latency, shed + request counters, in-flight depth."""
@@ -576,6 +602,9 @@ def serve_main(args, argv=None):
     from ..environment import prepare_env
     prepare_env(sargs['env'])
 
+    telemetry.adopt_config(sargs)
+    telemetry.set_process_label('serve')
+    telemetry.install_crash_dump()
     guard = PreemptionGuard().install()
     service = InferenceService(sargs).start()
     print(json.dumps({'serving_ready': {
@@ -584,6 +613,7 @@ def serve_main(args, argv=None):
     try:
         while not guard.requested() and not service.fleet_drain_requested():
             time.sleep(0.2)
+            service.poll_alerts()
         if guard.requested():
             _LOG.warning('serving: preemption signal received; draining')
     finally:

@@ -65,6 +65,7 @@ import threading
 import time
 import uuid
 import zlib
+from collections import deque
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -85,6 +86,16 @@ def set_enabled(flag: bool):
     global _ENABLED
     _ENABLED = bool(flag)
     os.environ['HANDYRL_TPU_TELEMETRY'] = '1' if _ENABLED else '0'
+
+
+# the flight recorder rides the same master switch but also has its own
+# (bench.py's recorder A/B isolates the ring cost from metric/span cost)
+_RECORDER_ON = True
+
+
+def set_recorder_enabled(flag: bool):
+    global _RECORDER_ON
+    _RECORDER_ON = bool(flag)
 
 
 # ---------------------------------------------------------------------------
@@ -112,7 +123,9 @@ def set_run_id(rid: Optional[str]):
 # Default per-config knobs for the ``telemetry`` block (a bare bool in the
 # config is accepted as {'enabled': <bool>} for back-compat).
 TELEMETRY_DEFAULTS: Dict[str, Any] = {
-    'enabled': True, 'trace_dir': '', 'trace_sample_rate': 1.0}
+    'enabled': True, 'trace_dir': '', 'trace_sample_rate': 1.0,
+    'blackbox_dir': 'blackbox', 'recorder_events': 256,
+    'metrics_rotate_mb': 0, 'alerts': {}}
 
 
 def config_block(args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -190,7 +203,8 @@ def set_process_label(label: str):
 
 def adopt_config(args: Optional[Dict[str, Any]]):
     """One call for every process that receives the merged run config:
-    run id, the collection switch, and the trace destination/sampling."""
+    run id, the collection switch, the trace destination/sampling, and the
+    flight-recorder geometry."""
     args = args or {}
     set_run_id(args.get('run_id'))
     tel = config_block(args)
@@ -198,6 +212,8 @@ def adopt_config(args: Optional[Dict[str, Any]]):
         set_enabled(False)
     configure_tracing(tel.get('trace_dir') or None,
                       tel.get('trace_sample_rate'))
+    configure_recorder(tel.get('recorder_events'),
+                       tel.get('blackbox_dir'))
 
 
 def episode_trace_id(task_args: Optional[Dict[str, Any]]) -> Optional[str]:
@@ -389,12 +405,207 @@ def get_logger(name: str = 'handyrl_tpu') -> logging.Logger:
                     '[%(asctime)s %(levelname).1s %(process)d %(name)s] '
                     '%(message)s', datefmt='%H:%M:%S'))
                 root.addHandler(handler)
+                # every leveled line also lands in the flight-recorder
+                # ring, so a blackbox dump carries the process's last
+                # log context alongside spans/transitions/guard trips
+                root.addHandler(_RecorderLogHandler())
                 root.setLevel(_log_level())
                 root.propagate = False
                 _LOG_CONFIGURED = True
     if name in ('', 'handyrl_tpu'):
         return root
     return root.getChild(name.replace('handyrl_tpu.', '', 1))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring of recent events, dumped on abnormal death
+
+RECORDER_EVENTS_DEFAULT = 256
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of this process's recent events: leveled log
+    lines, span completions, state-machine transitions, and guard trips.
+
+    Every fleet process keeps one (learner, gathers, workers, inference
+    supervisors, serving services, the fleet resolver). When the process
+    dies abnormally — uncaught fatal error, PreemptionGuard signal,
+    NonFiniteGuard abort, or a supervisor declaring a child dead — the ring
+    is dumped atomically (``utils/fs``) to
+    ``<blackbox_dir>/<role>-<pid>-<run_id>.json`` so
+    ``scripts/postmortem.py`` can reconstruct each corpse's last seconds
+    without a debugger. Recording is one deque append under a lock and
+    honours the global telemetry switch (``telemetry: false`` disables it
+    with the rest of the plane).
+    """
+
+    def __init__(self, capacity: int = RECORDER_EVENTS_DEFAULT):
+        self._lock = threading.Lock()
+        # ring + counters share one lock (graftlint GL004 discipline)
+        self._events: deque = deque(maxlen=max(16, int(capacity)))  # guarded-by: _lock
+        self._total = 0                 # guarded-by: _lock
+        self._dumps: List[str] = []     # guarded-by: _lock
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            return self._events.maxlen or 0
+
+    def set_capacity(self, capacity: int):
+        cap = max(16, int(capacity))
+        with self._lock:
+            if cap != self._events.maxlen:
+                self._events = deque(self._events, maxlen=cap)
+
+    def record(self, kind: str, msg: str, **fields):
+        if not (_ENABLED and _RECORDER_ON):
+            return
+        ev = {'t': round(time.time(), 6), 'kind': str(kind),
+              'msg': str(msg)[:500]}
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+            self._total += 1
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            held = len(self._events)
+            return {'events': held, 'total': self._total,
+                    'dropped': max(0, self._total - held),
+                    'capacity': self._events.maxlen,
+                    'dumps': list(self._dumps)}
+
+    def dump(self, reason: str, directory: Optional[str] = None,
+             context: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Atomically write the ring (plus a summarized registry snapshot)
+        to the blackbox file for this process. Returns the path, or None
+        when dumping is disabled (empty dir) or the write failed — a dump
+        must never take the dying process down harder."""
+        directory = _BLACKBOX_DIR if directory is None else directory
+        if not directory:
+            return None
+        role = re.sub(r'[^A-Za-z0-9_.-]', '_', _TRACE.label or 'proc')
+        path = os.path.join(directory,
+                            '%s-%d-%s.json' % (role, os.getpid(), _RUN_ID))
+        payload = {
+            'schema': 'handyrl_tpu.blackbox/1',
+            'role': _TRACE.label, 'pid': os.getpid(), 'run_id': _RUN_ID,
+            'reason': str(reason), 'time': round(time.time(), 6),
+            'stats': self.stats(), 'events': self.events(),
+            'metrics': summarize(REGISTRY.snapshot()),
+        }
+        if context:
+            payload['context'] = context
+        try:
+            os.makedirs(directory, exist_ok=True)
+            from .utils.fs import atomic_write_bytes
+            atomic_write_bytes(path, json.dumps(payload).encode('utf-8'))
+        except Exception:
+            return None
+        with self._lock:
+            if path not in self._dumps:
+                self._dumps.append(path)
+        return path
+
+
+class _RecorderLogHandler(logging.Handler):
+    """Mirror leveled log lines into the flight-recorder ring."""
+
+    def emit(self, record):  # noqa: D102 (logging API)
+        try:
+            _RECORDER.record('log', record.getMessage(),
+                             level=record.levelname, logger=record.name)
+        except Exception:
+            pass   # the recorder must never break logging
+
+
+_RECORDER = FlightRecorder(
+    int(os.environ.get('HANDYRL_TPU_RECORDER_EVENTS')
+        or RECORDER_EVENTS_DEFAULT))
+_BLACKBOX_DIR = os.environ.get('HANDYRL_TPU_BLACKBOX', 'blackbox')
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def recorder_stats() -> Dict[str, Any]:
+    return _RECORDER.stats()
+
+
+def blackbox_dir() -> str:
+    return _BLACKBOX_DIR
+
+
+def configure_recorder(events: Optional[int] = None,
+                       directory: Optional[str] = None,
+                       force: bool = False):
+    """Adopt recorder geometry from the run config, mirrored into the
+    environment so spawned children inherit it. Operator-set
+    ``HANDYRL_TPU_RECORDER_EVENTS`` / ``HANDYRL_TPU_BLACKBOX`` win over
+    config values unless ``force`` (tests, bench A/B runs)."""
+    global _BLACKBOX_DIR
+    if events is not None and (force or
+                               not os.environ.get('HANDYRL_TPU_RECORDER_EVENTS')):
+        _RECORDER.set_capacity(int(events))
+        os.environ['HANDYRL_TPU_RECORDER_EVENTS'] = str(_RECORDER.capacity)
+    if directory is not None and (force or
+                                  not os.environ.get('HANDYRL_TPU_BLACKBOX')):
+        _BLACKBOX_DIR = str(directory).strip()
+        os.environ['HANDYRL_TPU_BLACKBOX'] = _BLACKBOX_DIR
+
+
+def record_event(kind: str, msg: str, **fields):
+    """Append one event to this process's flight-recorder ring (a single
+    deque append under a lock; a no-op with telemetry disabled)."""
+    _RECORDER.record(kind, msg, **fields)
+
+
+def dump_blackbox(reason: str, **context) -> Optional[str]:
+    """Dump the flight recorder for an abnormal-death reason (fatal-error,
+    preempt, nonfinite-abort, crash declarations). Idempotent per process:
+    a later dump atomically replaces the earlier file with a fresher
+    ring."""
+    path = _RECORDER.dump(reason, context=context or None)
+    if path:
+        counter('blackbox_dumps_total').inc()
+        get_logger('recorder').warning('blackbox dump (%s): %s',
+                                       reason, path)
+        trace_flush()
+    return path
+
+
+_CRASH_HOOK_INSTALLED = False
+
+
+def install_crash_dump():
+    """Chain ``sys.excepthook`` so an uncaught fatal error dumps the flight
+    recorder before the traceback prints. Installed once per process at
+    the fleet entry points (learner, gather, worker, serving service,
+    fleet resolver). KeyboardInterrupt is left to the PreemptionGuard
+    path; SystemExit never reaches the hook."""
+    global _CRASH_HOOK_INSTALLED
+    if _CRASH_HOOK_INSTALLED:
+        return
+    _CRASH_HOOK_INSTALLED = True
+    prev = sys.excepthook
+
+    def hook(tp, val, tb):
+        if not issubclass(tp, KeyboardInterrupt):
+            try:
+                record_event('fatal', '%s: %s' % (tp.__name__, val))
+                dump_blackbox('fatal-error',
+                              error='%s: %s' % (tp.__name__, str(val)[:200]))
+            except Exception:
+                pass   # dumping must never mask the real traceback
+        prev(tp, val, tb)
+
+    sys.excepthook = hook
 
 
 # ---------------------------------------------------------------------------
@@ -641,6 +852,7 @@ class MetricRegistry:
         finally:
             dt = time.perf_counter() - t0
             hist.observe(dt)
+            _RECORDER.record('span', stage, seconds=round(dt, 6))
             log = get_logger('span')
             if log.isEnabledFor(logging.DEBUG):
                 log.debug('span %s run=%s t=%.6f dur=%.6f parent=%s',
@@ -653,6 +865,8 @@ class MetricRegistry:
             return
         self.histogram('stage_seconds', stage=stage).observe_agg(
             seconds, count)
+        _RECORDER.record('span', stage, seconds=round(seconds, 6),
+                         count=count)
 
     def snapshot(self, reset: bool = False) -> Dict[str, Any]:
         """Plain-data (msgpack/json-safe) dump of every metric; with
@@ -766,6 +980,251 @@ def summarize(snap: Dict[str, Any]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# SLO alert engine: declarative rules over merged registry/fleet snapshots
+
+# Built-in alert catalog. Each rule is declarative: a metric selector
+# (name or list of names, summed over matching label sets), a value kind
+# (``value`` = current level, ``rate`` = per-second counter increase
+# between evaluations, ``ratio`` = rate(metric)/rate(denominator) — the
+# burn rate over the existing latency/shed counters), a comparison, a
+# sustain window (``for`` seconds the breach must hold before firing) and
+# a ``clear_for`` debounce before an active alert clears. ``arm_metric``
+# keeps a rule silent until its subsystem has shown life (ingest stall
+# must not fire before the first episode ever arrives). Custom rules from
+# the ``telemetry.alerts`` config block override built-ins by name.
+BUILTIN_ALERTS: Tuple[Dict[str, Any], ...] = (
+    {'name': 'ingest_stall',
+     'metric': 'learner_episodes_returned_total', 'kind': 'rate',
+     'op': '<=', 'threshold': 0.0, 'for': 60.0,
+     'arm_metric': 'learner_episodes_returned_total'},
+    {'name': 'policy_lag_runaway',
+     'metric': 'policy_lag_mean', 'kind': 'value',
+     'op': '>', 'threshold': 16.0, 'for': 30.0},
+    {'name': 'nonfinite_spike',
+     'metric': 'guard_nonfinite_total', 'kind': 'rate',
+     'op': '>', 'threshold': 0.2},
+    {'name': 'serve_shed_burn',
+     'metric': ['serve_shed_total', 'engine_shed_total'], 'kind': 'ratio',
+     'denominator': ['serve_requests_total', 'engine_requests_total'],
+     'op': '>', 'threshold': 0.05, 'for': 10.0},
+    {'name': 'replica_quarantine_flap',
+     'metric': ['fleet_replica_transitions_total',
+                'fleet_host_transitions_total'],
+     'labels': 'to="quarantined"', 'kind': 'rate',
+     'op': '>', 'threshold': 0.05},
+    {'name': 'heartbeat_misses',
+     'metric': ['fleet_heartbeat_misses_total', 'hub_disconnects_total'],
+     'kind': 'rate', 'op': '>', 'threshold': 0.0},
+)
+
+_ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {
+    '>': lambda v, t: v > t, '>=': lambda v, t: v >= t,
+    '<': lambda v, t: v < t, '<=': lambda v, t: v <= t,
+}
+
+
+def _metric_value(snaps: List[Optional[Dict[str, Any]]],
+                  names, label_sub: str = '') -> float:
+    """Sum a metric selector over snapshots: counters and gauges by value,
+    histograms by observation count; label_sub (e.g. ``to="quarantined"``)
+    restricts to matching label sets."""
+    if isinstance(names, str):
+        names = (names,)
+    total = 0.0
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for section in ('counters', 'gauges'):
+            for key, v in (snap.get(section) or {}).items():
+                name, labels = split_key(key)
+                if name in names and (not label_sub or label_sub in labels):
+                    total += float(v)
+        for key, h in (snap.get('hists') or {}).items():
+            name, labels = split_key(key)
+            if name in names and (not label_sub or label_sub in labels):
+                total += int(h.get('count', 0))
+    return total
+
+
+class AlertRule:
+    """One normalized rule plus its evaluation state (sustain/clear
+    windows, last rate sample)."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.name = str(spec['name'])
+        self.metric = spec.get('metric') or ()
+        self.denominator = spec.get('denominator') or ()
+        self.kind = str(spec.get('kind', 'value'))
+        self.labels = str(spec.get('labels', ''))
+        self.op = str(spec.get('op', '>'))
+        self.threshold = float(spec.get('threshold', 0.0))
+        self.for_s = float(spec.get('for', 0.0))
+        self.clear_for = float(spec.get('clear_for', 0.0))
+        self.arm_metric = spec.get('arm_metric') or ()
+        if self.kind not in ('value', 'rate', 'ratio'):
+            raise ValueError('alert %r: unknown kind %r'
+                             % (self.name, self.kind))
+        if self.op not in _ALERT_OPS:
+            raise ValueError('alert %r: unknown op %r' % (self.name, self.op))
+        if self.kind == 'ratio' and not self.denominator:
+            raise ValueError('alert %r: ratio needs a denominator'
+                             % self.name)
+        # evaluation state (engine-lock protected via AlertEngine)
+        self.active = False
+        self.fired = 0
+        self.last_value = 0.0
+        self.breach_since: Optional[float] = None
+        self.ok_since: Optional[float] = None
+        self._prev: Optional[Tuple[float, float, float]] = None  # t, num, den
+
+    def _rates(self, snaps, now) -> Tuple[float, float]:
+        num = _metric_value(snaps, self.metric, self.labels)
+        den = _metric_value(snaps, self.denominator, self.labels) \
+            if self.denominator else 0.0
+        prev, self._prev = self._prev, (now, num, den)
+        if prev is None or now <= prev[0]:
+            return 0.0, 0.0
+        dt = now - prev[0]
+        return (max(0.0, num - prev[1]) / dt,
+                max(0.0, den - prev[2]) / dt)
+
+    def value(self, snaps, now) -> float:
+        if self.kind == 'value':
+            return _metric_value(snaps, self.metric, self.labels)
+        num_rate, den_rate = self._rates(snaps, now)
+        if self.kind == 'rate':
+            return num_rate
+        return (num_rate / den_rate) if den_rate > 0 else 0.0
+
+
+class AlertEngine:
+    """Evaluate declarative SLO rules against merged registry snapshots.
+
+    One engine runs on the learner (against local + merged fleet
+    snapshots), one on the fleet resolver, one in the serving service.
+    Fired alerts land as ``alerts_active{alert=}`` gauges,
+    ``alerts_fired_total{alert=}`` counters, WARNING log transitions,
+    flight-recorder events, and — on the learner — an ``alerts`` block in
+    every metrics_jsonl record. ``maybe_evaluate`` is cadence-gated so the
+    learner loop, the epoch writer and /statusz scrapes share one
+    evaluation stream (rates need a stable window)."""
+
+    def __init__(self, rules: Optional[Sequence[Dict[str, Any]]] = None,
+                 interval: float = 5.0):
+        specs = BUILTIN_ALERTS if rules is None else rules
+        self.interval = max(0.2, float(interval))
+        self._lock = threading.Lock()
+        self._rules = [AlertRule(dict(s)) for s in specs]  # guarded-by: _lock
+        self._last: Dict[str, Any] = {'time': 0.0, 'active': [],
+                                      'fired': {}, 'values': {}}  # guarded-by: _lock
+        self._log = get_logger('alerts')
+
+    @classmethod
+    def from_config(cls, args: Optional[Dict[str, Any]]
+                    ) -> Optional['AlertEngine']:
+        """Build from the ``telemetry.alerts`` block: ``{builtin, interval,
+        rules: [...]}`` (or a bare rule list; False/{'enabled': False}
+        disables). Returns None with alerting or telemetry off."""
+        tel = config_block(args)
+        if not tel.get('enabled', True) or not _ENABLED:
+            return None
+        blk = tel.get('alerts')
+        if blk is False:
+            return None
+        if isinstance(blk, (list, tuple)):
+            blk = {'rules': list(blk)}
+        if not isinstance(blk, dict):
+            blk = {}
+        if not blk.get('enabled', True):
+            return None
+        by_name: Dict[str, Dict[str, Any]] = {}
+        if blk.get('builtin', True):
+            for spec in BUILTIN_ALERTS:
+                by_name[str(spec['name'])] = dict(spec)
+        for spec in (blk.get('rules') or []):
+            if isinstance(spec, dict) and spec.get('name'):
+                merged = dict(by_name.get(str(spec['name'])) or {})
+                merged.update(spec)
+                by_name[str(spec['name'])] = merged
+        return cls(list(by_name.values()),
+                   interval=float(blk.get('interval', 5.0)))
+
+    def rule_names(self) -> List[str]:
+        with self._lock:
+            return [r.name for r in self._rules]
+
+    def evaluate(self, snaps: List[Optional[Dict[str, Any]]],
+                 now: Optional[float] = None) -> Dict[str, Any]:
+        """One evaluation pass; returns the ``alerts`` block."""
+        now = time.time() if now is None else float(now)
+        fired, cleared = [], []
+        with self._lock:
+            for rule in self._rules:
+                armed = (not rule.arm_metric
+                         or _metric_value(snaps, rule.arm_metric) > 0)
+                value = rule.value(snaps, now)
+                rule.last_value = value
+                breach = armed and _ALERT_OPS[rule.op](value, rule.threshold)
+                if breach:
+                    rule.ok_since = None
+                    if rule.breach_since is None:
+                        rule.breach_since = now
+                    if (not rule.active
+                            and now - rule.breach_since >= rule.for_s):
+                        rule.active = True
+                        rule.fired += 1
+                        fired.append((rule.name, value))
+                else:
+                    rule.breach_since = None
+                    if rule.active:
+                        if rule.ok_since is None:
+                            rule.ok_since = now
+                        if now - rule.ok_since >= rule.clear_for:
+                            rule.active = False
+                            rule.ok_since = None
+                            cleared.append((rule.name, value))
+            block = {
+                'time': round(now, 3),
+                'active': sorted(r.name for r in self._rules if r.active),
+                'fired': {r.name: r.fired for r in self._rules if r.fired},
+                'values': {r.name: round(r.last_value, 6)
+                           for r in self._rules},
+            }
+            self._last = block
+        for name, value in fired:
+            counter('alerts_fired_total', alert=name).inc()
+            gauge('alerts_active', alert=name).set(1)
+            record_event('alert', 'fired %s (value=%g)' % (name, value),
+                         alert=name, state='firing')
+            self._log.warning('alert FIRING: %s (value=%g)', name, value)
+        for name, value in cleared:
+            gauge('alerts_active', alert=name).set(0)
+            record_event('alert', 'cleared %s (value=%g)' % (name, value),
+                         alert=name, state='cleared')
+            self._log.warning('alert cleared: %s (value=%g)', name, value)
+        return block
+
+    def maybe_evaluate(self, collect: Callable[[], List[Dict[str, Any]]],
+                       now: Optional[float] = None) -> Dict[str, Any]:
+        """Cadence-gated evaluation: runs a pass at most every
+        ``interval`` seconds, otherwise returns the cached block."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            fresh = now - float(self._last.get('time') or 0.0) < self.interval
+        if fresh:
+            return self.block()
+        return self.evaluate(collect(), now)
+
+    def block(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._last)
+
+    def active(self) -> List[str]:
+        with self._lock:
+            return [r.name for r in self._rules if r.active]
+
+
+# ---------------------------------------------------------------------------
 # Prometheus text exposition
 
 
@@ -829,12 +1288,17 @@ class TelemetryExporter:
     ``collect`` returns the snapshots to serve (called per scrape, so the
     endpoint always shows live registry values); ``port=0`` binds an
     ephemeral port (tests), a fixed port serves operators' scrape configs.
-    ``/metrics`` answers the exposition text; every other path 404s.
+    ``/metrics`` answers the exposition text, ``/healthz`` a liveness
+    ``ok`` line, ``/statusz`` a JSON health view (run identity, recorder
+    stats, plus whatever the ``status`` callable contributes — active
+    alerts, fleet states, run progress); every other path 404s.
     """
 
     def __init__(self, collect: Callable[[], List[Dict[str, Any]]],
-                 port: int = 0, host: str = ''):
+                 port: int = 0, host: str = '',
+                 status: Optional[Callable[[], Dict[str, Any]]] = None):
         self._collect = collect
+        self._status = status
         self._host = host
         self._port = int(port)
         self._server = None
@@ -844,13 +1308,46 @@ class TelemetryExporter:
     def port(self) -> int:
         return self._port
 
+    def status_payload(self) -> Dict[str, Any]:
+        """The /statusz JSON: base process identity + recorder stats,
+        overlaid with the owner's status callable (alerts, fleet states,
+        progress, SLO snapshots)."""
+        base: Dict[str, Any] = {
+            'run_id': _RUN_ID, 'role': _TRACE.label, 'pid': os.getpid(),
+            'time': round(time.time(), 3), 'recorder': recorder_stats()}
+        if self._status is not None:
+            extra = self._status()
+            if isinstance(extra, dict):
+                base.update(extra)
+        return base
+
     def start(self) -> 'TelemetryExporter':
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _respond(self, body: bytes, ctype: str):
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split('?')[0] not in ('/metrics', '/'):
+                path = self.path.split('?')[0]
+                if path == '/healthz':
+                    self._respond(b'ok\n', 'text/plain; charset=utf-8')
+                    return
+                if path == '/statusz':
+                    try:
+                        body = json.dumps(exporter.status_payload(),
+                                          sort_keys=True).encode()
+                    except Exception as exc:   # a broken status callable
+                        self.send_error(500, str(exc)[:120])   # 500s, only
+                        return
+                    self._respond(body, 'application/json; charset=utf-8')
+                    return
+                if path not in ('/metrics', '/'):
                     self.send_error(404)
                     return
                 try:
@@ -858,12 +1355,8 @@ class TelemetryExporter:
                 except Exception as exc:   # a broken collector must not
                     self.send_error(500, str(exc)[:120])   # kill the server
                     return
-                self.send_response(200)
-                self.send_header('Content-Type',
-                                 'text/plain; version=0.0.4; charset=utf-8')
-                self.send_header('Content-Length', str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(
+                    body, 'text/plain; version=0.0.4; charset=utf-8')
 
             def log_message(self, fmt, *args):
                 get_logger('exporter').debug(fmt, *args)
@@ -983,4 +1476,82 @@ def validate_metrics_line(line: str, fleet: bool = False) -> Dict[str, Any]:
         ft = rec.get('fleet_telemetry')
         if not isinstance(ft, dict) or 'counters' not in ft:
             raise ValueError('fleet_telemetry missing/malformed: %r' % (ft,))
+    if 'alerts' in rec:
+        ab = rec['alerts']
+        if not isinstance(ab, dict) or 'active' not in ab:
+            raise ValueError('alerts block malformed: %r' % (ab,))
     return rec
+
+
+# ---------------------------------------------------------------------------
+# operator status view (``main.py --status <host:port>``)
+
+
+def fetch_statusz(target: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """GET http://<target>/statusz and parse the JSON payload."""
+    import urllib.request
+    with urllib.request.urlopen('http://%s/statusz' % target,
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode('utf-8'))
+
+
+def render_status(payload: Dict[str, Any]) -> str:
+    """Human-readable rendering of one /statusz payload."""
+    lines = ['%s pid=%s run=%s' % (payload.get('role', '?'),
+                                   payload.get('pid', '?'),
+                                   payload.get('run_id', '?'))]
+    progress = payload.get('progress')
+    if isinstance(progress, dict):
+        lines.append('progress: ' + ' '.join(
+            '%s=%s' % (k, progress[k]) for k in sorted(progress)))
+    alerts = payload.get('alerts')
+    if isinstance(alerts, dict):
+        active = alerts.get('active') or []
+        lines.append('alerts: %s'
+                     % (', '.join('FIRING %s' % a for a in active)
+                        if active else 'none active'))
+        fired = alerts.get('fired') or {}
+        if fired:
+            lines.append('  fired so far: ' + ', '.join(
+                '%s x%d' % (k, fired[k]) for k in sorted(fired)))
+    for key in ('fleet_hosts', 'fleet_replicas'):
+        states = payload.get(key)
+        if isinstance(states, dict) and states:
+            lines.append('%s: ' % key.replace('_', ' ') + ', '.join(
+                '%s=%s' % (k, states[k]) for k in sorted(states)))
+    slo = payload.get('slo')
+    if isinstance(slo, dict):
+        lines.append('slo: ' + ' '.join(
+            '%s=%s' % (k, slo[k]) for k in sorted(slo)))
+    rec = payload.get('recorder')
+    if isinstance(rec, dict):
+        lines.append('recorder: %s/%s events (%s dropped), %d dump(s)'
+                     % (rec.get('events', 0), rec.get('capacity', 0),
+                        rec.get('dropped', 0), len(rec.get('dumps') or [])))
+    return '\n'.join(lines)
+
+
+def status_main(args: Optional[Dict[str, Any]], argv: Sequence[str]):
+    """``main.py --status <host:port>``: fetch a live /statusz (the
+    learner's telemetry_port or a serving metrics_port) and render it."""
+    rest = [a for a in argv if not a.startswith('--')]
+    target = rest[0] if rest else ''
+    if not target:
+        port = int((args or {}).get('telemetry_port') or 0)
+        if port:
+            target = 'localhost:%d' % port
+    if not target:
+        print('usage: main.py --status <host:port> [--json]')
+        raise SystemExit(1)
+    if ':' not in target:
+        target = 'localhost:' + target
+    try:
+        payload = fetch_statusz(target)
+    except Exception as exc:
+        print('status fetch from %s failed: %s' % (target, exc))
+        raise SystemExit(1)
+    if '--json' in argv:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(render_status(payload))
+    return payload

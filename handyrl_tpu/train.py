@@ -55,7 +55,7 @@ from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
 from .utils.fetch import put_tree
-from .utils.fs import append_jsonl, checksummed_write_bytes
+from .utils.fs import append_jsonl, checksummed_write_bytes, rotate_file
 from .worker import WorkerCluster, WorkerServer
 
 _LOG = telemetry.get_logger('train')
@@ -1053,9 +1053,18 @@ class Learner:
         telemetry.set_process_label('learner')
         telemetry.configure_tracing(tel.get('trace_dir') or None,
                                     tel.get('trace_sample_rate'))
+        telemetry.configure_recorder(tel.get('recorder_events'),
+                                     tel.get('blackbox_dir'))
         if telemetry.enabled():
             # XLA compile-event counters (cache hits, compile durations)
             telemetry.install_jax_monitoring()
+            # fatal errors leave a blackbox dump behind (sys.excepthook)
+            telemetry.install_crash_dump()
+        # SLO alert engine: builtin catalog + telemetry.alerts overrides,
+        # evaluated on the server loop / epoch writer / statusz scrapes
+        # through one cadence-gated stream (None with alerting off)
+        self._alerts = telemetry.AlertEngine.from_config(args)
+        self._metrics_rotate_mb = float(tel.get('metrics_rotate_mb') or 0)
         self._last_fleet_telemetry: Optional[dict] = None
         self._exporter = None
         # epoch means of the policy-lag/sample-age histograms are computed
@@ -1213,7 +1222,8 @@ class Learner:
         export_port = int(args.get('telemetry_port') or 0)
         if export_port and telemetry.enabled():
             self._exporter = telemetry.TelemetryExporter(
-                self._telemetry_snapshots, port=export_port).start()
+                self._telemetry_snapshots, port=export_port,
+                status=self._status_info).start()
 
         self._metrics_path = args.get('metrics_jsonl') or ''
         # optional wall-clock budget (absolute unix time): long quality runs
@@ -1652,6 +1662,22 @@ class Learner:
             snaps.append(telemetry.relabel(fleet, source='fleet'))
         return snaps
 
+    def _status_info(self) -> Dict[str, Any]:
+        """/statusz payload: run progress, alert state, fleet host map.
+        Scrape-driven alert evaluation shares the cadence gate with the
+        server loop, so a scrape storm cannot distort rate windows."""
+        info: Dict[str, Any] = {'progress': {
+            'epoch': self.model_epoch,
+            'steps': int(getattr(self.trainer, 'steps', 0)),
+            'episodes': self.num_returned_episodes,
+            'buffer': len(self.trainer.episodes)}}
+        if self._alerts is not None:
+            info['alerts'] = self._alerts.maybe_evaluate(
+                self._telemetry_snapshots)
+        if getattr(self, 'fleet', None) is not None:
+            info['fleet_hosts'] = self.fleet.snapshot()
+        return info
+
     def _merge_fleet_telemetry(self) -> dict:
         """Aggregate the registry snapshots that rode in on the latest
         heartbeat per peer (gathers pre-merge their workers' snapshots)."""
@@ -1787,6 +1813,17 @@ class Learner:
         if self.worker is not None:
             rec['fleet_telemetry'] = telemetry.summarize(
                 self._merge_fleet_telemetry())
+        # SLO alert state rides every record: active names, cumulative
+        # fired counts, and the last evaluated value per rule
+        if self._alerts is not None:
+            rec['alerts'] = self._alerts.maybe_evaluate(
+                self._telemetry_snapshots)
+        # size-based rotation (telemetry.metrics_rotate_mb): long runs must
+        # not grow the JSONL unboundedly — atomic rename to `.1` keeps one
+        # previous generation around for postmortems
+        if self._metrics_rotate_mb > 0 and rotate_file(
+                self._metrics_path, self._metrics_rotate_mb):
+            telemetry.counter('metrics_rotations_total').inc()
         # append-safe single-write line + fsync: a killed learner can never
         # leave a torn half-line that breaks downstream JSONL parsing
         append_jsonl(self._metrics_path, rec)
@@ -2393,6 +2430,10 @@ class Learner:
                     telemetry.HOST_STATE_CODES[state])
                 telemetry.counter('fleet_host_transitions_total',
                                   **{'from': prev, 'to': state}).inc()
+            if self._alerts is not None:
+                # the cadence gate makes this an ~interval-spaced stream
+                # even though the loop spins every recv timeout
+                self._alerts.maybe_evaluate(self._telemetry_snapshots)
 
         while self.worker.connection_count() > 0 or not self.shutdown_flag:
             if self.preempt.requested():

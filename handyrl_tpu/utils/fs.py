@@ -131,6 +131,26 @@ def read_layout_manifest(path: str):
     return layout, 'ok'
 
 
+def rotate_file(path: str, max_mb: float) -> bool:
+    """Size-gated single-generation rotation: when ``path`` exceeds
+    ``max_mb`` megabytes it is atomically renamed to ``<path>.1``
+    (replacing any previous generation) and True is returned — the next
+    append recreates the live file. os.replace on the same filesystem is
+    atomic, so a concurrent reader sees the old file or the rotated one,
+    never a truncation in progress. Missing file / non-positive cap is a
+    no-op."""
+    if not path or max_mb <= 0:
+        return False
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    if size < max_mb * 1024 * 1024:
+        return False
+    os.replace(path, path + '.1')
+    return True
+
+
 def append_jsonl(path: str, record: dict):
     """Append ``record`` to a JSONL file append-safely.
 

@@ -74,6 +74,7 @@ class Worker:
         _LOG.info('opened worker %d', wid)
         telemetry.adopt_config(args)
         telemetry.set_process_label('worker-%d' % wid)
+        telemetry.install_crash_dump()
         self.worker_id = wid
         self.conn = conn
         self.env = make_env({**args['env'], 'id': wid})
@@ -155,8 +156,7 @@ class Worker:
                 self._maybe_heartbeat()
                 task = self._rpc(('args', None))
             except _CONN_ERRORS:
-                _LOG.warning('worker %d: lost its gather; exiting',
-                             self.worker_id)
+                self._gather_lost()
                 return
             if task is None:
                 return
@@ -174,6 +174,7 @@ class Worker:
                 models = self.vault.obtain(dict(task.get('model_id', {})))
                 payload = produce(models, task)
             except _CONN_ERRORS:       # model fetch rode the dead pipe
+                self._gather_lost()
                 return
             except Exception:
                 traceback.print_exc()
@@ -187,7 +188,17 @@ class Worker:
             try:
                 self._rpc((upload_as, payload))
             except _CONN_ERRORS:
+                self._gather_lost()
                 return
+
+    def _gather_lost(self):
+        """The pipe to the gather died under us: leave a blackbox dump
+        behind (the postmortem's evidence of WHICH side died first) and
+        let the process exit — the gather supervisor owns respawns."""
+        _LOG.warning('worker %d: lost its gather; exiting', self.worker_id)
+        telemetry.record_event('guard', 'gather connection lost',
+                               worker=self.worker_id)
+        telemetry.dump_blackbox('gather-lost', worker=self.worker_id)
 
 
 def open_worker(args, conn, wid):
@@ -259,6 +270,7 @@ class Gather:
         _LOG.info('started gather %d', gather_id)
         telemetry.adopt_config(args)
         telemetry.set_process_label('gather-%d' % gather_id)
+        telemetry.install_crash_dump()
         self.gather_id = gather_id
         self._upload_trace = UploadTrace(gather_id)
         gid = str(gather_id)
@@ -666,6 +678,8 @@ class RemoteWorkerCluster:
     def run(self):
         merged = entry(self.args)
         telemetry.adopt_config(merged)
+        telemetry.set_process_label('worker-host')
+        telemetry.install_crash_dump()
         _LOG.info('joined run %s as %s (base_worker_id %s, %s gathers)',
                   merged.get('run_id', '?'), self.args['address'],
                   merged['worker'].get('base_worker_id'),
@@ -736,6 +750,14 @@ class RemoteWorkerCluster:
                     delay = backoffs[i].next_delay()
                     _LOG.warning('gather %d died (exit %s); respawning '
                                  'in %.1fs', i, proc.exitcode, delay)
+                    # supervisor death declaration: the gather itself had
+                    # no chance to dump (SIGKILL), so the host supervisor
+                    # records the evidence for the postmortem
+                    telemetry.record_event(
+                        'supervisor', 'gather %d died' % i,
+                        exitcode=proc.exitcode, respawn_in=round(delay, 2))
+                    telemetry.dump_blackbox('gather-death', gather=i,
+                                            exitcode=proc.exitcode)
                     time.sleep(delay)
                     children[i] = spawn(i)
                     started_at[i] = time.time()

@@ -19,6 +19,8 @@ modes:
   --serve-fleet, -sf   replicated serving fleet: resolver/router +
                        serving.fleet.replicas managed replicas (SLO-driven
                        autoscaling, zero-loss failover, rolling promotes)
+  --status             render a live /statusz health view [HOST:PORT]
+                       (active alerts, fleet states, progress, recorder)
 """
 
 
@@ -67,6 +69,9 @@ def main():
     elif mode in ('--serve-fleet', '-sf'):
         from handyrl_tpu.serving.fleet import resolver_main
         resolver_main(args, rest)
+    elif mode == '--status':
+        from handyrl_tpu.telemetry import status_main
+        status_main(args.get('train_args'), rest)
     else:
         print('Not found mode %s.' % mode)
         print(USAGE)

@@ -364,6 +364,76 @@ def validate(args):
                                    _OBS_DOC, clean_params)) == []
 
 
+# GL005 alert-rule vocabulary (telemetry.BUILTIN_ALERTS <-> alert catalog)
+
+
+_ALERT_SRC = '''
+BUILTIN_ALERTS = (
+    {'name': 'documented_alert', 'metric': 'documented_total',
+     'kind': 'rate', 'op': '>', 'threshold': 0.0},
+    {'name': 'undocumented_alert', 'metric': 'documented_total',
+     'kind': 'value', 'op': '>', 'threshold': 1.0},
+)
+'''
+
+_OBS_ALERT_DOC = _OBS_DOC + '''## Alerting and postmortems
+### Alert catalog
+| alert | meaning |
+|---|---|
+| `documented_alert` | fires on stall |
+| `stale_alert` | rule was deleted |
+'''
+
+
+def test_gl005_alert_vocabulary_both_directions():
+    tree = _gl005_tree(obs=_OBS_ALERT_DOC)
+    tree['handyrl_tpu/telemetry.py'] = _src('handyrl_tpu/telemetry.py',
+                                            _ALERT_SRC)
+    blob = ' | '.join(f.message for f in check_gl005(tree))
+    assert "'undocumented_alert'" in blob      # rule -> missing catalog row
+    assert "'stale_alert'" in blob             # catalog row -> no such rule
+    assert "'documented_alert'" not in blob    # matched pair is silent
+
+
+def test_gl005_alert_clean_twin_passes():
+    clean_emitter = '''
+from . import telemetry
+C = telemetry.counter('documented_total')
+G = telemetry.counter('ghost_total')
+
+def f():
+    with telemetry.trace_span('select'):
+        pass
+'''
+    clean_config = '''
+TRAIN_DEFAULTS = {
+    'gamma': 0.8,
+}
+
+def validate(args):
+    ta = args['train_args']
+    assert float(ta.get('gamma')) > 0
+'''
+    clean_params = _PARAM_DOC.replace(
+        "| `phantom_knob` | 1 | no longer exists |\n", '')
+    clean_obs = _OBS_DOC + '''## Alerting and postmortems
+### Alert catalog
+| alert | meaning |
+|---|---|
+| `documented_alert` | fires on stall |
+'''
+    clean_alert_src = '''
+BUILTIN_ALERTS = (
+    {'name': 'documented_alert', 'metric': 'documented_total',
+     'kind': 'rate', 'op': '>', 'threshold': 0.0},
+)
+'''
+    tree = _gl005_tree(clean_emitter, clean_config, clean_obs, clean_params)
+    tree['handyrl_tpu/telemetry.py'] = _src('handyrl_tpu/telemetry.py',
+                                            clean_alert_src)
+    assert check_gl005(tree) == []
+
+
 # ---------------------------------------------------------------------------
 # pragmas + baseline
 

@@ -136,3 +136,68 @@ def test_eval_checkpoints_script(trained_models, monkeypatch, tmp_path):
     rows3 = [json.loads(l) for l in open(out)]
     assert [r['epoch'] for r in rows3] == [1, 2], \
         'skip-scored rerun must evaluate epochs missing from the file'
+
+
+def test_trace_report_json_schema(tmp_path, capsys):
+    """scripts/trace_report.py --json output contract: every consumer-facing
+    key present, stage/segment rows shaped {n, p50, p95}, and the
+    exit-code contract (0 with a complete chain, 2 without)."""
+    import json
+
+    import trace_report
+
+    def ev(name, ts, dur, pid, trace_id=None, trace_ids=None):
+        args = {}
+        if trace_id:
+            args['trace_id'] = trace_id
+        if trace_ids:
+            args['trace_ids'] = trace_ids
+        return json.dumps({'name': name, 'cat': 'handyrl', 'ph': 'X',
+                           'ts': ts, 'dur': dur, 'pid': pid, 'tid': 1,
+                           'args': args})
+
+    trace = tmp_path / 'trace-run1.jsonl'
+    trace.write_text('\n'.join([
+        ev('task_assign', 1000, 10, 1, trace_id='g7'),
+        ev('generate', 2000, 5000, 2, trace_id='g7'),
+        ev('upload', 8000, 300, 3, trace_id='g7'),
+        ev('ingest', 9000, 100, 1, trace_id='g7'),
+        ev('train_step', 10000, 2000, 1, trace_ids=['g7']),
+        ev('decode', 9500, 50, 1),
+        '{torn half-line',
+    ]) + '\n')
+
+    assert trace_report.main([str(tmp_path), '--json']) == 0
+    report = json.loads(capsys.readouterr().out)
+    for key in ('events', 'processes', 'chains', 'complete_chains',
+                'order_violations', 'stage_seconds', 'segment_seconds',
+                'generation_to_gradient_seconds'):
+        assert key in report, 'missing %r' % key
+    assert report['events'] == 6
+    assert report['processes'] == 3
+    assert report['chains'] == 1
+    assert report['complete_chains'] == 1
+    assert report['order_violations'] == 0
+    for table in ('stage_seconds', 'segment_seconds'):
+        for name, row in report[table].items():
+            assert set(row) == {'n', 'p50', 'p95'}, (table, name)
+            assert row['n'] >= 1
+    assert 'decode' in report['stage_seconds']
+    g2g = report['generation_to_gradient_seconds']
+    assert set(g2g) == {'n', 'p50', 'p95'}
+    # generate start (ts=2000us) -> train_step end (12000us) = 10ms
+    assert g2g['n'] == 1 and abs(g2g['p50'] - 0.01) < 1e-9
+
+    # exit contract: an incomplete chain (no train_step) exits 2
+    broken = tmp_path / 'broken'
+    broken.mkdir()
+    (broken / 'trace-run2.jsonl').write_text('\n'.join([
+        ev('task_assign', 1000, 10, 1, trace_id='g9'),
+        ev('generate', 2000, 5000, 2, trace_id='g9'),
+    ]) + '\n')
+    assert trace_report.main([str(broken), '--json']) == 2
+    capsys.readouterr()
+    # and an empty dir exits 2 without output
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert trace_report.main([str(empty)]) == 2

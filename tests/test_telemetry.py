@@ -321,6 +321,201 @@ def test_exporter_serves_metrics_over_http():
 
 
 # ---------------------------------------------------------------------------
+# flight recorder + blackbox dumps
+
+
+def test_flight_recorder_ring_bounds_and_stats():
+    rec = telemetry.FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record('test', 'event %d' % i, i=i)
+    st = rec.stats()
+    assert st['events'] == 16 and st['total'] == 40 and st['dropped'] == 24
+    assert rec.capacity == 16
+    assert [e['i'] for e in rec.events()] == list(range(24, 40))
+
+
+def test_flight_recorder_set_capacity_keeps_newest():
+    rec = telemetry.FlightRecorder(capacity=64)
+    for i in range(40):
+        rec.record('test', 'e', i=i)
+    rec.set_capacity(16)
+    assert [e['i'] for e in rec.events()] == list(range(24, 40))
+
+
+def test_flight_recorder_dump_schema(tmp_path):
+    rec = telemetry.FlightRecorder(capacity=16)
+    rec.record('guard', 'something tripped', detail=7)
+    path = rec.dump('unit-test', directory=str(tmp_path),
+                    context={'k': 1})
+    assert path and os.path.exists(path)
+    payload = json.load(open(path))
+    assert payload['schema'] == 'handyrl_tpu.blackbox/1'
+    assert payload['reason'] == 'unit-test'
+    assert payload['context'] == {'k': 1}
+    assert payload['pid'] == os.getpid()
+    assert payload['events'][-1]['msg'] == 'something tripped'
+    assert path in rec.stats()['dumps']
+    # an empty directory disables dumping entirely
+    assert rec.dump('unit-test', directory='') is None
+
+
+def test_flight_recorder_disabled_is_inert(monkeypatch):
+    rec = telemetry.FlightRecorder(capacity=16)
+    monkeypatch.setattr(telemetry, '_ENABLED', False)
+    rec.record('test', 'dropped')
+    assert rec.stats()['total'] == 0
+
+
+def test_recorder_only_toggle_leaves_metrics_live():
+    rec = telemetry.FlightRecorder(capacity=16)
+    telemetry.set_recorder_enabled(False)
+    try:
+        rec.record('test', 'dropped')
+        telemetry.counter('recorder_toggle_probe_total').inc()
+    finally:
+        telemetry.set_recorder_enabled(True)
+    assert rec.stats()['total'] == 0
+    assert telemetry.counter('recorder_toggle_probe_total').value == 1
+    rec.record('test', 'kept')
+    assert rec.stats()['total'] == 1
+
+
+def test_log_warnings_land_in_recorder():
+    before = len([e for e in telemetry.recorder().events()
+                  if e.get('kind') == 'log'])
+    telemetry.get_logger('recorder-test').warning('recorder mirror check')
+    logged = [e for e in telemetry.recorder().events()
+              if e.get('kind') == 'log']
+    assert len(logged) > before
+    assert any('recorder mirror check' in e['msg'] for e in logged)
+
+
+# ---------------------------------------------------------------------------
+# SLO alert engine
+
+
+def _gauge_snap(**gauges):
+    return [{'counters': {}, 'gauges': dict(gauges), 'hists': {}}]
+
+
+def _counter_snap(**counters):
+    return [{'counters': dict(counters), 'gauges': {}, 'hists': {}}]
+
+
+def test_alert_value_rule_sustain_and_clear_debounce():
+    eng = telemetry.AlertEngine([
+        {'name': 'deep_queue', 'metric': 'q_depth', 'kind': 'value',
+         'op': '>', 'threshold': 5.0, 'for': 10.0, 'clear_for': 5.0}])
+    blk = eng.evaluate(_gauge_snap(q_depth=9.0), now=100.0)
+    assert blk['active'] == []                 # must sustain 10 s first
+    blk = eng.evaluate(_gauge_snap(q_depth=9.0), now=111.0)
+    assert blk['active'] == ['deep_queue']
+    assert blk['fired'] == {'deep_queue': 1}
+    assert telemetry.gauge('alerts_active', alert='deep_queue').value == 1
+    blk = eng.evaluate(_gauge_snap(q_depth=1.0), now=112.0)
+    assert blk['active'] == ['deep_queue']     # clear_for debounce holds
+    blk = eng.evaluate(_gauge_snap(q_depth=1.0), now=120.0)
+    assert blk['active'] == []
+    assert telemetry.gauge('alerts_active', alert='deep_queue').value == 0
+
+
+def test_alert_rate_rule_needs_two_samples():
+    eng = telemetry.AlertEngine([
+        {'name': 'err_burst', 'metric': 'errs_total', 'kind': 'rate',
+         'op': '>', 'threshold': 1.0}])
+    assert eng.evaluate(_counter_snap(errs_total=0),
+                        now=10.0)['active'] == []
+    blk = eng.evaluate(_counter_snap(errs_total=30), now=20.0)   # 3/s
+    assert blk['active'] == ['err_burst']
+    assert blk['values']['err_burst'] == 3.0
+
+
+def test_alert_ratio_rule_burn_rate():
+    eng = telemetry.AlertEngine([
+        {'name': 'shed_burn', 'metric': 'shed_total', 'kind': 'ratio',
+         'denominator': 'reqs_total', 'op': '>', 'threshold': 0.05}])
+    eng.evaluate(_counter_snap(shed_total=0, reqs_total=0), now=0.0)
+    blk = eng.evaluate(_counter_snap(shed_total=10, reqs_total=100),
+                       now=10.0)
+    assert blk['active'] == ['shed_burn']      # 10% of requests shed
+
+
+def test_alert_arm_metric_gates_until_first_signal():
+    eng = telemetry.AlertEngine([
+        {'name': 'stall', 'metric': 'eps_total', 'kind': 'rate',
+         'op': '<=', 'threshold': 0.0, 'arm_metric': 'eps_total'}])
+    empty = _counter_snap()
+    assert eng.evaluate(empty, now=1.0)['active'] == []
+    assert eng.evaluate(empty, now=2.0)['active'] == []    # still unarmed
+    live = _counter_snap(eps_total=5)
+    eng.evaluate(live, now=3.0)
+    blk = eng.evaluate(live, now=4.0)          # armed; zero rate breaches
+    assert blk['active'] == ['stall']
+
+
+def test_alert_engine_from_config_merge_and_disable():
+    eng = telemetry.AlertEngine.from_config({'telemetry': {'alerts': {
+        'rules': [
+            {'name': 'ingest_stall', 'threshold': 1.0},
+            {'name': 'custom_rule', 'metric': 'q_depth', 'kind': 'value',
+             'op': '>', 'threshold': 2.0}]}}})
+    names = eng.rule_names()
+    assert 'custom_rule' in names
+    assert names.count('ingest_stall') == 1    # override, not duplicate
+    builtin = {str(s['name']) for s in telemetry.BUILTIN_ALERTS}
+    assert builtin <= set(names)
+    assert telemetry.AlertEngine.from_config(
+        {'telemetry': {'alerts': False}}) is None
+    assert telemetry.AlertEngine.from_config({'telemetry': False}) is None
+
+
+def test_alert_maybe_evaluate_is_cadence_gated():
+    eng = telemetry.AlertEngine([
+        {'name': 'deep_queue', 'metric': 'q_depth', 'kind': 'value',
+         'op': '>', 'threshold': 5.0}], interval=5.0)
+    calls = []
+
+    def collect():
+        calls.append(1)
+        return _gauge_snap(q_depth=9.0)
+
+    eng.maybe_evaluate(collect, now=100.0)
+    eng.maybe_evaluate(collect, now=101.0)     # inside the cadence window
+    assert len(calls) == 1
+    blk = eng.maybe_evaluate(collect, now=106.0)
+    assert len(calls) == 2
+    assert blk['active'] == ['deep_queue']
+
+
+# ---------------------------------------------------------------------------
+# status surface (/healthz, /statusz, main.py --status)
+
+
+def test_exporter_serves_healthz_and_statusz():
+    reg = MetricRegistry()
+    exporter = TelemetryExporter(
+        lambda: [reg.snapshot()], port=0,
+        status=lambda: {'progress': {'epoch': 3},
+                        'alerts': {'active': ['ingest_stall']}}).start()
+    try:
+        base = 'http://127.0.0.1:%d' % exporter.port
+        assert urllib.request.urlopen(
+            base + '/healthz', timeout=10).read() == b'ok\n'
+        payload = json.loads(urllib.request.urlopen(
+            base + '/statusz', timeout=10).read().decode())
+        assert payload['progress'] == {'epoch': 3}
+        assert payload['alerts']['active'] == ['ingest_stall']
+        assert payload['pid'] == os.getpid()
+        assert 'run_id' in payload and 'recorder' in payload
+        rendered = telemetry.render_status(payload)
+        assert 'ingest_stall' in rendered
+        fetched = telemetry.fetch_statusz('127.0.0.1:%d' % exporter.port)
+        assert fetched['pid'] == os.getpid()
+    finally:
+        exporter.stop()
+
+
+# ---------------------------------------------------------------------------
 # append-safe JSONL + schema checker
 
 
@@ -331,6 +526,19 @@ def test_append_jsonl_writes_complete_lines(tmp_path):
         append_jsonl(path, {'epoch': i, 'v': 'x' * 100})
     lines = open(path).read().splitlines()
     assert [json.loads(l)['epoch'] for l in lines] == [0, 1, 2]
+
+
+def test_rotate_file_caps_metrics_jsonl(tmp_path):
+    from handyrl_tpu.utils.fs import rotate_file
+    path = str(tmp_path / 'metrics.jsonl')
+    with open(path, 'w') as f:
+        f.write('x' * 2048)
+    assert not rotate_file(path, 1.0)          # under the cap: untouched
+    assert not rotate_file(path, 0)            # 0 = rotation off
+    assert rotate_file(path, 0.001)            # ~1 KB cap: rotate
+    assert not os.path.exists(path)
+    assert os.path.getsize(path + '.1') == 2048
+    assert not rotate_file(path, 0.001)        # gone now: nothing to do
 
 
 def test_validate_metrics_line_schema():
