@@ -288,7 +288,8 @@ def validate(args: Dict[str, Any]) -> None:
     assert isinstance(tel, (bool, dict)), \
         'telemetry must be a bool or a block (enabled / trace_dir / ' \
         'trace_sample_rate / blackbox_dir / recorder_events / ' \
-        'metrics_rotate_mb / alerts)'
+        'metrics_rotate_mb / alerts / perf_plane / retrace / ' \
+        'retrace_warmup_epochs)'
     tel_enabled = bool(tel.get('enabled', True)) if isinstance(tel, dict) \
         else bool(tel)
     if isinstance(tel, dict):
@@ -313,6 +314,11 @@ def validate(args: Dict[str, Any]) -> None:
             assert isinstance(rule, dict) and rule.get('name') \
                 and rule.get('metric'), \
                 'each telemetry.alerts rule needs at least name + metric'
+        assert str(tel.get('retrace', 'warn')).lower() in \
+            ('warn', 'abort', 'off'), \
+            "telemetry.retrace must be 'warn', 'abort' or 'off'"
+        assert int(tel.get('retrace_warmup_epochs', 1)) >= 0, \
+            'telemetry.retrace_warmup_epochs must be >= 0'
     if ta.get('profile_epochs'):
         epochs = parse_epoch_set(ta['profile_epochs'])
         assert epochs and all(e >= 1 for e in epochs), \

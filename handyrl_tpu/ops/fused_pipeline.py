@@ -208,6 +208,10 @@ class FusedPipeline:
         # sharded along 'data', actor params / train state / the packed
         # host fetch replicated — placement is part of the program, not an
         # accident of where the caller left the inputs.
+        # name the programs so the retrace sentinel (telemetry.py) can
+        # report WHICH compiled callable re-lowered after steady state
+        warmup.__name__ = 'fused_pipeline_warmup'
+        fused.__name__ = 'fused_pipeline_train'
         if mesh is None:
             self._warmup = jax.jit(warmup,
                                    donate_argnums=(1, 2, 3, 4, 5, 6, 7))

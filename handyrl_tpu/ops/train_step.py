@@ -156,6 +156,9 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True,
     use_pallas_targets()
 
     update = _update_core(module, cfg, make_optimizer())
+    # name the program so the retrace sentinel (telemetry.py) can report
+    # WHICH compiled callable re-lowered after steady state
+    update.__name__ = 'train_update_step'
 
     if mesh is None:
         return jax.jit(update, donate_argnums=(0,) if donate else ())
@@ -237,6 +240,7 @@ def build_replay_update(module, cfg: LossConfig, capacity: int,
         summed = jax.tree_util.tree_map(lambda m: jnp.sum(m, axis=0), stacked)
         return state, key, summed
 
+    fused.__name__ = 'replay_fused_update'
     if mesh is None:
         return jax.jit(fused, donate_argnums=(0, 2))
     repl = replicated_sharding(mesh)

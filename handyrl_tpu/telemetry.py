@@ -125,7 +125,10 @@ def set_run_id(rid: Optional[str]):
 TELEMETRY_DEFAULTS: Dict[str, Any] = {
     'enabled': True, 'trace_dir': '', 'trace_sample_rate': 1.0,
     'blackbox_dir': 'blackbox', 'recorder_events': 256,
-    'metrics_rotate_mb': 0, 'alerts': {}}
+    'metrics_rotate_mb': 0, 'alerts': {},
+    # compiled-performance plane (docs/observability.md): device-memory
+    # gauges, the retrace sentinel, and the host-block decomposition
+    'perf_plane': True, 'retrace': 'warn', 'retrace_warmup_epochs': 1}
 
 
 def config_block(args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -214,6 +217,7 @@ def adopt_config(args: Optional[Dict[str, Any]]):
                       tel.get('trace_sample_rate'))
     configure_recorder(tel.get('recorder_events'),
                        tel.get('blackbox_dir'))
+    configure_perf_plane(tel.get('perf_plane'), tel.get('retrace'))
 
 
 def episode_trace_id(task_args: Optional[Dict[str, Any]]) -> Optional[str]:
@@ -659,9 +663,13 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 # Canonical ingest-path stage vocabulary, shared by StageTimer epoch lines,
-# BENCH_MODE=ingest rows, and the stage_seconds histogram family.
+# BENCH_MODE=ingest rows, and the stage_seconds histogram family. The old
+# aggregate 'compute' stage is decomposed into 'dispatch' (the async
+# compiled-step call returning) and 'host_block' (block_until_ready / lazy
+# metric fetch — the host pinned to the device stream), which is what the
+# device-utilization proxy is computed from.
 INGEST_STAGES: Tuple[str, ...] = (
-    'select', 'decode', 'assemble', 'ipc', 'h2d', 'compute', 'drain')
+    'select', 'decode', 'assemble', 'ipc', 'h2d', 'dispatch', 'host_block')
 
 # Row-count buckets for batching histograms (e.g. the inference engine's
 # engine_batch_rows): powers of two matching the padded dispatch buckets.
@@ -1015,6 +1023,15 @@ BUILTIN_ALERTS: Tuple[Dict[str, Any], ...] = (
     {'name': 'heartbeat_misses',
      'metric': ['fleet_heartbeat_misses_total', 'hub_disconnects_total'],
      'kind': 'rate', 'op': '>', 'threshold': 0.0},
+    # compiled-performance plane (docs/observability.md "Compiled-
+    # performance plane"): sustained HBM pressure, and any post-warm-up
+    # XLA recompilation (each one stalls the device for the full compile)
+    {'name': 'hbm_pressure',
+     'metric': 'device_mem_utilization', 'kind': 'value',
+     'op': '>', 'threshold': 0.92, 'for': 30.0, 'clear_for': 30.0},
+    {'name': 'retrace_storm',
+     'metric': 'xla_retraces_total', 'kind': 'rate',
+     'op': '>', 'threshold': 0.0, 'clear_for': 60.0},
 )
 
 _ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -1445,6 +1462,12 @@ def install_jax_monitoring() -> bool:
                                        float(duration))
         except Exception:
             pass
+        # Retrace sentinel: after mark_steady_state() every lowering event
+        # is a recompile the steady-state train loop should never see.
+        # Deliberately OUTSIDE the try/except so the abort policy's
+        # RetraceError propagates into the jitted call site.
+        if _STEADY['on'] and event == _RETRACE_EVENT:
+            _note_retrace(event)
 
     try:
         _jm.register_event_listener(_on_event)
@@ -1453,6 +1476,317 @@ def install_jax_monitoring() -> bool:
         return False
     _JAX_MONITORING_INSTALLED = True
     return True
+
+
+# ---------------------------------------------------------------------------
+# Compiled-performance plane (docs/observability.md "Compiled-performance
+# plane"): device-memory gauges, the steady-state retrace sentinel, and the
+# dispatch/host_block utilization proxy. All process-local state lives in
+# the two dicts below so tests can reset it cleanly.
+
+RETRACE_POLICIES = ('warn', 'abort', 'off')
+
+# The lowering duration event fires on every in-memory jit-cache miss —
+# unlike backend_compile, which the persistent compilation cache can skip —
+# so it is the reliable "a retrace happened" signal.
+_RETRACE_EVENT = '/jax/core/compile/jaxpr_to_mlir_module_duration'
+
+_PERF_PLANE: Dict[str, Any] = {
+    'enabled': True, 'retrace': 'warn', 'last_mem': [], 'util': None}
+
+_STEADY: Dict[str, Any] = {
+    'on': False, 'since': 0.0, 'retraces': 0, 'note': '',
+    'last_compile': '', 'filter_on': False}
+
+
+class RetraceError(RuntimeError):
+    """Raised at a jitted call site when a post-steady-state XLA retrace
+    occurs under the ``abort`` policy (HANDYRL_TPU_RETRACE=abort)."""
+
+
+def configure_perf_plane(enabled=None, retrace=None):
+    """Adopt the ``telemetry.perf_plane`` / ``telemetry.retrace`` config
+    knobs (called from adopt_config on every process in the fleet)."""
+    if enabled is not None:
+        _PERF_PLANE['enabled'] = bool(enabled)
+    if retrace is not None:
+        retrace = str(retrace).strip().lower()
+        if retrace in RETRACE_POLICIES:
+            _PERF_PLANE['retrace'] = retrace
+
+
+def perf_plane_enabled() -> bool:
+    return _ENABLED and bool(_PERF_PLANE['enabled'])
+
+
+def retrace_policy() -> str:
+    """Active retrace policy: the HANDYRL_TPU_RETRACE env knob (the CI
+    override) wins over the ``telemetry.retrace`` config value."""
+    env = os.environ.get('HANDYRL_TPU_RETRACE', '').strip().lower()
+    if env in RETRACE_POLICIES:
+        return env
+    return _PERF_PLANE['retrace']
+
+
+class _CompileNameFilter(logging.Filter):
+    """Captures the callable/shape key from jax's ``jax_log_compiles``
+    WARNING ("Compiling <fn> with global shapes and types [...]") — the
+    only place jax names what it is compiling — and swallows the record so
+    the sentinel, not jax, owns the operator-facing message."""
+
+    def filter(self, record):
+        try:
+            msg = record.getMessage()
+            if msg.startswith('Compiling'):
+                key = msg.split('. Argument mapping', 1)[0]
+                _STEADY['last_compile'] = key[:300]
+                return False
+            if msg.startswith('Finished '):
+                # jax_log_compiles' per-phase "Finished tracing/lowering/
+                # compilation" chatter — the sentinel owns the message
+                return False
+        except Exception:
+            pass
+        return True
+
+
+_COMPILE_FILTER = _CompileNameFilter()
+_COMPILE_LOGGERS = ('jax._src.interpreters.pxla', 'jax._src.dispatch')
+
+
+def mark_steady_state(note: str = ''):
+    """Declare warm-up over: from here on, every XLA compile is a retrace
+    the sentinel counts, records, and (under the abort policy) raises on.
+    The Trainer crosses this boundary after ``retrace_warmup_epochs``."""
+    if not (perf_plane_enabled() and _JAX_MONITORING_INSTALLED):
+        return False
+    if _STEADY['on']:
+        return True
+    _STEADY.update(on=True, since=time.time(), retraces=0, note=note)
+    try:
+        import jax
+        jax.config.update('jax_log_compiles', True)
+        if not _STEADY['filter_on']:
+            for name in _COMPILE_LOGGERS:
+                logging.getLogger(name).addFilter(_COMPILE_FILTER)
+            _STEADY['filter_on'] = True
+    except Exception:
+        pass   # sentinel still counts retraces, just without callable names
+    gauge('xla_steady_state').set(1)
+    record_event('steady_state', 'steady state marked%s'
+                 % ((': ' + note) if note else ''), policy=retrace_policy())
+    return True
+
+
+def clear_steady_state():
+    """Leave steady state (learner shutdown, or test teardown). The flag is
+    process-global, so in-process learners must clear it or a later jit in
+    the same process would trip the sentinel."""
+    _STEADY.update(on=False, note='', last_compile='')
+    gauge('xla_steady_state').set(0)
+    try:
+        import jax
+        if _STEADY['filter_on']:
+            for name in _COMPILE_LOGGERS:
+                logging.getLogger(name).removeFilter(_COMPILE_FILTER)
+            _STEADY['filter_on'] = False
+        jax.config.update('jax_log_compiles', False)
+    except Exception:
+        pass
+
+
+def steady_state_active() -> bool:
+    return bool(_STEADY['on'])
+
+
+def steady_retrace_count() -> int:
+    return int(_STEADY['retraces'])
+
+
+# Signature-polymorphic helpers (utils/fetch.py's per-signature packed-
+# transfer jits, eval-share probes) legitimately compile NEW programs after
+# warm-up — once per fresh signature, by design. They declare those scopes
+# with expected_compile() and the sentinel books the compile under
+# xla_expected_compiles_total instead of treating it as a retrace.
+# Thread-local because jit compilation is synchronous on the calling
+# thread, so the listener fires on the same thread that opened the scope.
+_EXPECTED_COMPILE = threading.local()
+
+
+@contextmanager
+def expected_compile(reason: str = ''):
+    """Declare that any XLA compile inside this scope is expected (a known
+    signature-polymorphic helper seeing a fresh signature), exempting it
+    from the retrace sentinel's count/warn/abort path."""
+    depth = getattr(_EXPECTED_COMPILE, 'depth', 0)
+    _EXPECTED_COMPILE.depth = depth + 1
+    _EXPECTED_COMPILE.reason = reason
+    try:
+        yield
+    finally:
+        _EXPECTED_COMPILE.depth = depth
+
+
+def _in_expected_compile() -> bool:
+    return getattr(_EXPECTED_COMPILE, 'depth', 0) > 0
+
+
+def _note_retrace(event: str):
+    """One post-steady-state recompile: count it, flight-record it, warn —
+    and under the abort policy raise so the jitted call site fails loudly.
+    The raise sits outside the metric try/except on purpose."""
+    policy = retrace_policy()
+    if policy == 'off':
+        return
+    if _in_expected_compile():
+        try:
+            counter('xla_expected_compiles_total').inc()
+        except Exception:
+            pass
+        return
+    who = _STEADY['last_compile'] or ('event ' + event.strip('/'))
+    try:
+        _STEADY['retraces'] += 1
+        counter('xla_retraces_total').inc()
+        record_event('retrace', 'steady-state XLA retrace: %s' % who,
+                     policy=policy, count=_STEADY['retraces'])
+        get_logger('retrace').warning(
+            'steady-state XLA retrace #%d (%s) — a shape/donation bucket '
+            'regression is recompiling the hot program', _STEADY['retraces'],
+            who)
+    except Exception:
+        pass
+    if policy == 'abort':
+        raise RetraceError(
+            'steady-state XLA retrace under HANDYRL_TPU_RETRACE=abort: %s'
+            % who)
+
+
+def _rss_memory() -> Dict[str, int]:
+    """CPU fallback when Device.memory_stats() is unavailable: process RSS
+    (current), VmHWM (peak), physical RAM (limit) — all from procfs."""
+    in_use = peak = limit = 0
+    try:
+        page = os.sysconf('SC_PAGE_SIZE')
+        with open('/proc/self/statm') as fh:
+            in_use = int(fh.read().split()[1]) * page
+        limit = os.sysconf('SC_PHYS_PAGES') * page
+    except Exception:
+        pass
+    try:
+        with open('/proc/self/status') as fh:
+            for line in fh:
+                if line.startswith('VmHWM:'):
+                    peak = int(line.split()[1]) * 1024
+                    break
+    except Exception:
+        try:
+            import resource
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            pass
+    return {'bytes_in_use': in_use,
+            'peak_bytes_in_use': max(peak, in_use),
+            'bytes_limit': limit}
+
+
+def sample_device_memory(devices=None):
+    """Sample per-device memory into the ``device_mem_bytes_*`` gauges.
+    Real accelerators report via Device.memory_stats(); backends without it
+    (CPU) get one process-RSS row labelled ``process_rss`` — one row, not
+    one per CPU "device", since they all share this process's memory."""
+    if not perf_plane_enabled():
+        return []
+    if devices is None:
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            devices = []
+    rows = []
+    for dev in devices:
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            label = '%s:%s' % (getattr(dev, 'platform', 'dev'),
+                               getattr(dev, 'id', len(rows)))
+            row = {'device': label,
+                   'bytes_in_use': int(stats.get('bytes_in_use', 0)),
+                   'peak_bytes_in_use': int(
+                       stats.get('peak_bytes_in_use',
+                                 stats.get('bytes_in_use', 0))),
+                   'bytes_limit': int(stats.get('bytes_limit', 0))}
+            rows.append(row)
+        else:
+            row = dict(_rss_memory(), device='process_rss')
+            rows.append(row)
+            break   # every CPU "device" is this same process
+    for row in rows:
+        dev = row['device']
+        gauge('device_mem_bytes_in_use', device=dev).set(row['bytes_in_use'])
+        gauge('device_mem_bytes_peak', device=dev).set(
+            row['peak_bytes_in_use'])
+        gauge('device_mem_bytes_limit', device=dev).set(row['bytes_limit'])
+    _PERF_PLANE['last_mem'] = rows
+    return rows
+
+
+def device_memory_utilization(rows=None):
+    """Worst-case bytes_in_use/bytes_limit across sampled devices — the
+    ``hbm_pressure`` alert input. Only the learner publishes the
+    ``device_mem_utilization`` gauge (a ratio must not be summed across
+    fleet snapshots the way counters are)."""
+    rows = _PERF_PLANE['last_mem'] if rows is None else rows
+    util = 0.0
+    for row in rows:
+        limit = float(row.get('bytes_limit') or 0)
+        if limit > 0:
+            util = max(util, float(row.get('bytes_in_use', 0)) / limit)
+    return util
+
+
+def utilization_from_stages(stages) -> Optional[float]:
+    """Device-utilization proxy from one epoch's ingest-stage seconds:
+    host_block / total. Near 1.0 the host spends the epoch waiting on the
+    device (device-bound, good); near 0.0 the device is starving behind
+    host work (select/decode/assemble/ipc/h2d/dispatch). Accepts plain
+    ``{stage: seconds}`` or StageTimer.snapshot's ``{stage: {'s':..}}``."""
+
+    def _sec(val):
+        if isinstance(val, dict):
+            val = val.get('s', 0.0)
+        return float(val or 0.0)
+
+    try:
+        total = sum(_sec(stages.get(s)) for s in INGEST_STAGES)
+        block = _sec(stages.get('host_block'))
+    except Exception:
+        return None
+    if total <= 0:
+        return None
+    return block / total
+
+
+def set_utilization_proxy(value):
+    if value is None or not perf_plane_enabled():
+        return
+    value = max(0.0, min(1.0, float(value)))
+    _PERF_PLANE['util'] = value
+    gauge('device_utilization_proxy').set(value)
+
+
+def perf_status() -> Dict[str, Any]:
+    """Compiled-performance block for /statusz (rendered by --status)."""
+    return {
+        'steady_state': bool(_STEADY['on']),
+        'retraces': int(_STEADY['retraces']),
+        'retrace_policy': retrace_policy(),
+        'device_memory': list(_PERF_PLANE['last_mem']),
+        'device_mem_utilization': device_memory_utilization(),
+        'device_utilization_proxy': _PERF_PLANE['util']}
 
 
 # ---------------------------------------------------------------------------
@@ -1523,6 +1857,26 @@ def render_status(payload: Dict[str, Any]) -> str:
     if isinstance(slo, dict):
         lines.append('slo: ' + ' '.join(
             '%s=%s' % (k, slo[k]) for k in sorted(slo)))
+    perf = payload.get('perf')
+    if isinstance(perf, dict):
+        bits = ['steady' if perf.get('steady_state') else 'warming',
+                'retraces=%s' % perf.get('retraces', 0),
+                'policy=%s' % perf.get('retrace_policy', '?')]
+        util = perf.get('device_utilization_proxy')
+        if util is not None:
+            bits.append('device_util=%.0f%%' % (float(util) * 100.0))
+        mem_util = perf.get('device_mem_utilization')
+        if mem_util:
+            bits.append('mem_util=%.0f%%' % (float(mem_util) * 100.0))
+        lines.append('perf: ' + ' '.join(bits))
+        for row in perf.get('device_memory') or []:
+            limit = row.get('bytes_limit') or 0
+            lines.append('  mem %s: %.0f MiB in use (peak %.0f) of %s'
+                         % (row.get('device', '?'),
+                            row.get('bytes_in_use', 0) / 2**20,
+                            row.get('peak_bytes_in_use', 0) / 2**20,
+                            ('%.0f MiB' % (limit / 2**20)) if limit
+                            else 'unknown'))
     rec = payload.get('recorder')
     if isinstance(rec, dict):
         lines.append('recorder: %s/%s events (%s dropped), %d dump(s)'

@@ -470,6 +470,7 @@ class Trainer:
         self.last_dynamics: Dict[str, float] = {}
         self.shutdown_flag = False
         self.failed = False
+        self.failed_reason = ''
         self.started = False
 
         # non-finite guard: the device update step skips bad steps in place
@@ -693,7 +694,7 @@ class Trainer:
                 self.state, self._sample_key, metrics = self.replay_update(
                     self.state, buffers, self._sample_key, size, cursor,
                     jnp.asarray(ema, jnp.float32))
-                timer.add('compute', time.perf_counter() - t_dispatch)
+                timer.add('dispatch', time.perf_counter() - t_dispatch)
                 self.replay_stats['samples_drawn'] += (
                     self.args['batch_size'] * self.fused_steps)
                 pending_metrics.append(metrics)
@@ -704,7 +705,9 @@ class Trainer:
                 # close needs ONE dispatch, not four (matters when
                 # max_sample_reuse throttles the loop)
                 if len(pending_metrics) >= 4 or self.update_flag:
+                    t_block = time.perf_counter()
                     data_cnt += self._drain_metrics(pending_metrics)
+                    timer.add('host_block', time.perf_counter() - t_block)
                     pending_metrics = []
                 if 0 <= profile_stop_at <= self.steps:
                     jax.block_until_ready(metrics['total'])
@@ -726,7 +729,7 @@ class Trainer:
             t_dispatch = time.perf_counter()
             self.state, metrics = self.update_step(self.state, batch, lr)
             dt_dispatch = time.perf_counter() - t_dispatch
-            timer.add('compute', dt_dispatch)
+            timer.add('dispatch', dt_dispatch)
             if batch_tids:
                 # the gradient end of the episode trace: one event per
                 # update, linking every sampled episode whose window this
@@ -742,9 +745,9 @@ class Trainer:
             # data_count is a device scalar; fetch lazily every few steps to
             # avoid a sync per update
             if len(pending_metrics) >= 8:
-                t_drain = time.perf_counter()
+                t_block = time.perf_counter()
                 data_cnt += self._drain_metrics(pending_metrics)
-                timer.add('drain', time.perf_counter() - t_drain)
+                timer.add('host_block', time.perf_counter() - t_block)
                 pending_metrics = []
             self.steps += 1
             if self.steps == profile_stop_at:
@@ -752,7 +755,9 @@ class Trainer:
                 self._stop_trace()
 
         if pending_metrics:
+            t_block = time.perf_counter()
             data_cnt += self._drain_metrics(pending_metrics)
+            timer.add('host_block', time.perf_counter() - t_block)
 
         if batch_cnt > 0:   # zero only when interrupted by shutdown
             loss_sum = self._loss_sum
@@ -765,11 +770,18 @@ class Trainer:
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
             self.last_dynamics = self._epoch_dynamics(loss_sum, data_cnt,
                                                       batch_cnt)
+            # the epoch's per-stage seconds feed the device-utilization
+            # proxy (host_block / total ingest time) whether or not the
+            # timing line is printed
+            line = self.ingest_timer.snapshot(reset=True)
+            util = telemetry.utilization_from_stages(line)
+            telemetry.set_utilization_proxy(util)
             if os.environ.get('HANDYRL_TPU_TIMING') == '1':
                 # one line per epoch: seconds + event counts per ingest
-                # stage ('compute' is dispatch time; 'drain' is the sync),
-                # plus the epoch's learning-dynamics summary
-                line = self.ingest_timer.snapshot(reset=True)
+                # stage ('dispatch' is async-issue time; 'host_block' is
+                # the device sync), plus the epoch's dynamics summary
+                if util is not None:
+                    line['util'] = round(util, 4)
                 if self.last_dynamics:
                     line['dynamics'] = self.last_dynamics
                 print('ingest timing: %s' % json.dumps(line))
@@ -980,7 +992,7 @@ class Trainer:
                 else:
                     time.sleep(0.5)
                     params, state_blob = None, None
-            except Exception:
+            except Exception as exc:
                 # deliver (None, ...) instead of deadlocking the learner
                 # (it blocks on update_queue at every epoch boundary); the
                 # learner sees `failed` and shuts the run down — a dead
@@ -991,6 +1003,8 @@ class Trainer:
                 # open trace (nor crash a later stop with a double-stop)
                 self._stop_trace()
                 self.failed = True
+                self.failed_reason = '%s: %s' % (type(exc).__name__,
+                                                 str(exc)[:300])
                 params, state_blob = None, None
             self.update_flag = False
             while not self.shutdown_flag:
@@ -1060,6 +1074,12 @@ class Learner:
             telemetry.install_jax_monitoring()
             # fatal errors leave a blackbox dump behind (sys.excepthook)
             telemetry.install_crash_dump()
+        # compiled-performance plane: device-memory gauges, the retrace
+        # sentinel (steady state marked after retrace_warmup_epochs), and
+        # the dispatch/host_block utilization proxy
+        telemetry.configure_perf_plane(tel.get('perf_plane'),
+                                       tel.get('retrace'))
+        self._retrace_warmup = int(tel.get('retrace_warmup_epochs', 1))
         # SLO alert engine: builtin catalog + telemetry.alerts overrides,
         # evaluated on the server loop / epoch writer / statusz scrapes
         # through one cadence-gated stream (None with alerting off)
@@ -1676,6 +1696,8 @@ class Learner:
                 self._telemetry_snapshots)
         if getattr(self, 'fleet', None) is not None:
             info['fleet_hosts'] = self.fleet.snapshot()
+        if telemetry.perf_plane_enabled():
+            info['perf'] = telemetry.perf_status()
         return info
 
     def _merge_fleet_telemetry(self) -> dict:
@@ -1799,6 +1821,23 @@ class Learner:
         rec['guard_nonfinite'] = self.trainer.guard.total_bad
         rec['guard_rollbacks'] = self.trainer.guard.rollbacks
         rec['guard_bad_episodes'] = self._bad_episodes
+        # compiled-performance plane: per-epoch device-memory sample (the
+        # hbm_pressure alert input — only the learner publishes the ratio
+        # gauge, a ratio must not sum across fleet snapshots), steady-state
+        # marking once warm-up is over, and the chaos retrace probe
+        # (HANDYRL_TPU_CHAOS=retraceepoch=N) for e2e sentinel drills
+        if telemetry.perf_plane_enabled():
+            mem_rows = telemetry.sample_device_memory()
+            telemetry.gauge('device_mem_utilization').set(
+                round(telemetry.device_memory_utilization(mem_rows), 6))
+            if (not telemetry.steady_state_active()
+                    and self.model_epoch >= self._retrace_warmup):
+                telemetry.mark_steady_state(
+                    'epoch %d (retrace_warmup_epochs=%d)'
+                    % (self.model_epoch, self._retrace_warmup))
+            chaos_at = self._chaos.get('retraceepoch')
+            if chaos_at is not None and self.model_epoch == int(chaos_at):
+                self._chaos_retrace_probe()
         if getattr(self, 'ledger', None) is not None:
             rec.update({'fleet_' + k: v
                         for k, v in self._fleet_snapshot().items()
@@ -1828,6 +1867,20 @@ class Learner:
         # leave a torn half-line that breaks downstream JSONL parsing
         append_jsonl(self._metrics_path, rec)
         telemetry.trace_flush()   # epoch boundary: land buffered spans
+
+    def _chaos_retrace_probe(self):
+        """Chaos hook: compile a deliberately fresh jitted program after
+        steady state so an e2e drill can watch the retrace sentinel fire
+        (retrace_storm alert, flight-recorder event, abort policy)."""
+        _LOG.warning('chaos: compiling a fresh program at epoch %d to '
+                     'trigger the retrace sentinel', self.model_epoch)
+
+        def chaos_retrace_probe(x):
+            return x + 1.0
+        # device_put (not jnp.zeros) so the only fresh compile the sentinel
+        # sees — and names — is chaos_retrace_probe itself
+        jax.jit(chaos_retrace_probe)(jax.device_put(
+            np.zeros((self.model_epoch % 7 + 1,), np.float32)))
 
     def _run_eval_share(self, evaluator, tracker: Dict[str, int]):
         """Advance online evaluation until its share of episodes reaches
@@ -2137,9 +2190,12 @@ class Learner:
         # feed_device_chunk is one fetch behind dispatch; chunk -> epoch
         # attribution therefore uses the epoch captured at dispatch time
         epoch_of_dispatch = deque()
-        # fused dispatch latency joins the same 'compute' stage histogram
-        # the threaded trainer's StageTimer mirror feeds
-        m_dispatch = telemetry.histogram('stage_seconds', stage='compute')
+        # fused dispatch/fetch latency joins the same 'dispatch' /
+        # 'host_block' stage histograms the threaded trainer's StageTimer
+        # mirror feeds; epoch deltas feed the device-utilization proxy
+        m_dispatch = telemetry.histogram('stage_seconds', stage='dispatch')
+        m_block = telemetry.histogram('stage_seconds', stage='host_block')
+        tlast = {'dispatch': 0.0, 'fetch': 0.0}
 
         def account(prev):
             if prev is None:
@@ -2194,7 +2250,9 @@ class Learner:
             t0 = time.time()
             if warm:
                 account(fp.warm_step(actor.params))
-                tacc['fetch'] += time.time() - t0
+                dt_fetch = time.time() - t0
+                tacc['fetch'] += dt_fetch
+                m_block.observe(dt_fetch)
             else:
                 ema = tr.data_cnt_ema
                 if tr.chaos_nan.due(tr.steps, fp.sgd_steps):
@@ -2208,7 +2266,9 @@ class Learner:
                 tr.steps += fp.sgd_steps
                 epoch_steps += fp.sgd_steps
                 account(prev)
-                tacc['fetch'] += time.time() - t1
+                dt_fetch = time.time() - t1
+                tacc['fetch'] += dt_fetch
+                m_block.observe(dt_fetch)
             tacc['iters'] += 1
 
             t2 = time.time()
@@ -2220,9 +2280,18 @@ class Learner:
                 self._fused_epoch(pending_metrics, epoch_steps,
                                   time.time() - epoch_t0, fp, evaluator)
                 tacc['epoch'] += time.time() - t3
+                # device-utilization proxy from this epoch's dispatch/fetch
+                # deltas: the fused loop's 'host_block' is the packed fetch
+                util = telemetry.utilization_from_stages(
+                    {'dispatch': tacc['dispatch'] - tlast['dispatch'],
+                     'host_block': tacc['fetch'] - tlast['fetch']})
+                telemetry.set_utilization_proxy(util)
+                tlast.update(dispatch=tacc['dispatch'], fetch=tacc['fetch'])
                 if timing:
-                    print('timing: %s' % json.dumps(
-                        {k: round(v, 2) for k, v in tacc.items()}))
+                    line = {k: round(v, 2) for k, v in tacc.items()}
+                    if util is not None:
+                        line['util'] = round(util, 4)
+                    print('timing: %s' % json.dumps(line))
                 pending_metrics.clear()   # account() closes over this list
                 epoch_steps = 0
                 epoch_t0 = time.time()
@@ -2618,6 +2687,10 @@ class Learner:
         take seconds per step on CPU, and an unjoined thread inside XLA
         compute at teardown aborts with 'exception not rethrown'."""
         self.shutdown_flag = True
+        # the steady-state flag is process-global: an in-process learner
+        # (tests, notebooks) must not leave the retrace sentinel armed for
+        # whatever jits next in this process
+        telemetry.clear_steady_state()
         self.trainer.shutdown()
         if self._trainer_thread is not None:
             self._trainer_thread.join(timeout=300)
@@ -2696,6 +2769,16 @@ def train_main(args):
         # supervisor contract: EX_TEMPFAIL asks for a restart into the
         # resume path (restart_epoch: -1 auto-resolves the snapshot)
         raise SystemExit(guard_mod.PREEMPT_EXIT_CODE)
+    _exit_if_train_failed(learner)
+
+
+def _exit_if_train_failed(learner):
+    """A dead optimizer (train-thread exception, e.g. a RetraceError under
+    HANDYRL_TPU_RETRACE=abort) shuts the run down gracefully — but the
+    PROCESS must still exit nonzero or CI reads the failure as a pass."""
+    if getattr(learner.trainer, 'failed', False):
+        raise SystemExit('training failed: %s'
+                         % (learner.trainer.failed_reason or 'see traceback'))
 
 
 def train_server_main(args):
@@ -2704,3 +2787,4 @@ def train_server_main(args):
     learner.run()
     if learner.preempt.fired:
         raise SystemExit(guard_mod.PREEMPT_EXIT_CODE)
+    _exit_if_train_failed(learner)

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
+
 _PACKERS: Dict[Tuple, Any] = {}
 _SPLITTERS: Dict[Tuple, Any] = {}
 
@@ -72,7 +74,10 @@ def fetch_tree(tree: Any) -> Any:
             flat_host = np.asarray(group[0]).reshape(-1)
         else:
             sig = (str(dtype), tuple(g.shape for g in group))
-            flat_host = np.asarray(_packer(sig)(group))
+            # per-signature cached jit: a FRESH signature compiles once by
+            # design, so the scope is declared to the retrace sentinel
+            with telemetry.expected_compile('fetch_tree packer'):
+                flat_host = np.asarray(_packer(sig)(group))
         pos = 0
         for i, g in zip(idxs, group):
             n = int(np.prod(g.shape)) if g.shape else 1
@@ -102,7 +107,8 @@ def put_tree(tree: Any) -> Any:
             continue
         shapes = tuple(tuple(g.shape) for g in group)
         flat = np.concatenate([g.reshape(-1) for g in group])
-        parts = _splitter((str(dtype), shapes))(jax.device_put(flat))
+        with telemetry.expected_compile('put_tree splitter'):
+            parts = _splitter((str(dtype), shapes))(jax.device_put(flat))
         for i, part in zip(idxs, parts):
             out[i] = part
     return jax.tree_util.tree_unflatten(treedef, out)

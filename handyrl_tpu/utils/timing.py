@@ -2,7 +2,8 @@
 
 The distributed learner path crosses several hand-off points (episode
 selection -> bz2 decode -> batch assembly -> batcher IPC -> host-to-device
-staging -> compiled update -> metric drain), and a regression in any one of
+staging -> async dispatch of the compiled update -> blocking on device
+results), and a regression in any one of
 them hides inside an aggregate episodes/sec number. ``StageTimer``
 accumulates wall seconds and event counts per named stage from any thread
 (batcher threads and the trainer thread share one instance), and the
@@ -12,7 +13,13 @@ reports, so a bench row and a live-run epoch line are directly comparable.
 
 Canonical stage names for the ingest path (telemetry.INGEST_STAGES is the
 one authoritative tuple):
-  select / decode / assemble / ipc / h2d / compute / drain
+  select / decode / assemble / ipc / h2d / dispatch / host_block
+
+``dispatch`` is the host time to issue the compiled update (async — the
+call returns as soon as XLA accepts the work); ``host_block`` is the time
+the host then spends blocked on device results (block_until_ready / metric
+fetch). Their ratio is the device-utilization proxy the compiled-
+performance plane exports (docs/observability.md).
 """
 
 from __future__ import annotations

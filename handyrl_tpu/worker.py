@@ -120,6 +120,9 @@ class Worker:
         if self._hb_interval <= 0 or now < self._hb_next:
             return
         self._hb_next = now + self._hb_interval
+        # refresh the device-memory gauges so every heartbeat snapshot
+        # carries this process's current footprint up the merge tree
+        telemetry.sample_device_memory()
         self.conn.send((HEARTBEAT_KIND,
                         {'worker': self.worker_id,
                          'telemetry': telemetry.snapshot()}))
@@ -389,6 +392,9 @@ class Gather:
                             for info in (hub.peer_info_snapshot().values()
                                          if hub is not None else ())
                             if isinstance(info, dict)]
+            # gather processes sample their own memory footprint too: a
+            # leaking relay shows up in the fleet merge, not just workers
+            telemetry.sample_device_memory()
             snap = telemetry.merge_snapshots(
                 [telemetry.snapshot()] + worker_snaps)
             conn = self.server

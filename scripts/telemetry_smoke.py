@@ -41,6 +41,9 @@ raw = {'env_args': {'env': 'TicTacToe'},
                       'telemetry_port': %(port)d}}
 learner = Learner(args=apply_defaults(raw))
 learner.run()
+if learner.trainer.failed:
+    raise SystemExit('SMOKE LEARNER TRAIN FAILED: '
+                     + (learner.trainer.failed_reason or 'see traceback'))
 print('SMOKE LEARNER DONE', learner.model_epoch, flush=True)
 '''
 
@@ -75,7 +78,11 @@ def main():
             try:
                 exposition = urllib.request.urlopen(
                     url, timeout=5).read().decode()
-                if 'episodes_generated_total' in exposition:
+                # wait for BOTH needles: episodes appear during generation,
+                # stage histograms only once batching starts — scraping in
+                # between is a race, not a failure
+                if ('episodes_generated_total' in exposition
+                        and 'stage_seconds_bucket' in exposition):
                     break
             except OSError:
                 pass

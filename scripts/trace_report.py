@@ -37,8 +37,9 @@ from typing import Any, Dict, List, Optional, Tuple
 CHAIN_STAGES = ('task_assign', 'generate', 'upload', 'ingest', 'train_step')
 
 # batch-level stages worth a duration summary when present
-BATCH_STAGES = ('select', 'decode', 'assemble', 'ipc', 'h2d', 'compute',
-                'drain', 'engine_batch', 'generate', 'upload', 'evaluate')
+BATCH_STAGES = ('select', 'decode', 'assemble', 'ipc', 'h2d', 'dispatch',
+                'host_block', 'engine_batch', 'generate', 'upload',
+                'evaluate')
 
 
 def discover_files(path: str) -> List[str]:
