@@ -164,6 +164,27 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         },
     },
 
+    # league training (league.py, docs/league.md): PFSP opponent sampling
+    # over registry versions + anchors, persistent Elo ratings, and a
+    # rating-gated champion promotion replacing recency auto_promote
+    'league': {
+        'enabled': False,        # worker-fleet 'g' tasks seat PFSP-sampled pool opponents and an 'e' slice becomes rating matches; requires serving.publish (the pool is the registry line). False = mirror self-play, records byte-identical to pre-league behavior
+        'line': '',              # registry line the pool draws members from; '' = serving.line
+        'anchors': ['random'],   # built-in pool members needing no checkpoint: 'random' (uniform legal play, usable in 'g' and 'e') and 'rulebase'/'rulebase-<key>' (env rule_based_action; 'e' rating matches only)
+        'curve': 'variance',     # PFSP weighting over the learner's per-member win rate p: 'variance' (p*(1-p), prefers even matchups), 'hard' ((1-p)^hard_exponent, prefers members the learner loses to), 'uniform'
+        'hard_exponent': 2.0,    # exponent k of the 'hard' curve's (1-p)^k weighting
+        'self_play_rate': 0.5,   # fraction of 'g' tasks kept as mirror self-play against the current epoch; the rest seat a PFSP-drawn pool member (deterministic per (seed, sample_key))
+        'rating_match_rate': 0.25,  # fraction of 'e' tasks turned into rating matches against a round-robin pool member (the rest keep the configured eval.opponent rotation)
+        'max_members': 8,        # newest registry versions kept in the member window (champion + rollback target always included); bounds the GC-pinned set
+        'initial_rating': 1200.0,  # Elo rating every member (and the learner) starts from
+        'k_factor': 32.0,        # Elo K: max rating delta per game (scaled down by sigma/initial_sigma when track_sigma is on)
+        'track_sigma': True,     # TrueSkill-lite: per-member sigma shrinks with games played and scales the effective K, so established ratings move slowly and fresh members converge fast
+        'initial_sigma': 200.0,  # starting rating uncertainty under track_sigma
+        'min_sigma': 50.0,       # sigma floor under track_sigma (effective K never collapses to 0)
+        'promote_margin': 30.0,  # rating-gated promotion: the learner must clear the incumbent champion member's rating by this many Elo points
+        'min_games': 20,         # rated games the learner must book since the last champion flip before promotion is considered
+    },
+
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
     # + episode-lifecycle distributed tracing. Accepts a bool (legacy
@@ -399,6 +420,35 @@ def validate(args: Dict[str, Any]) -> None:
         assert r_port.isdigit() and 0 < int(r_port) <= 65535, \
             "serving.fleet.resolver must look like 'host:port' (got %r)" \
             % resolver
+    lg = ta.get('league') or {}
+    assert str(lg.get('curve', 'variance')) in \
+        ('variance', 'hard', 'uniform'), \
+        "league.curve must be 'variance', 'hard' or 'uniform'"
+    assert float(lg.get('hard_exponent', 2.0)) > 0, \
+        'league.hard_exponent must be > 0'
+    assert 0.0 <= float(lg.get('self_play_rate', 0.5)) <= 1.0, \
+        'league.self_play_rate must be a fraction in [0, 1]'
+    assert 0.0 <= float(lg.get('rating_match_rate', 0.25)) <= 1.0, \
+        'league.rating_match_rate must be a fraction in [0, 1]'
+    assert int(lg.get('max_members', 8)) >= 1, \
+        'league.max_members must be >= 1'
+    assert float(lg.get('k_factor', 32.0)) > 0, \
+        'league.k_factor must be > 0'
+    assert float(lg.get('promote_margin', 30.0)) >= 0, \
+        'league.promote_margin must be >= 0'
+    assert int(lg.get('min_games', 20)) >= 1, \
+        'league.min_games must be >= 1'
+    assert float(lg.get('initial_sigma', 200.0)) \
+        >= float(lg.get('min_sigma', 50.0)) > 0, \
+        'league sigma bounds need initial_sigma >= min_sigma > 0'
+    for anchor in (lg.get('anchors') or []):
+        assert anchor == 'random' or str(anchor).startswith('rulebase'), \
+            "league.anchors entries must be 'random' or 'rulebase[-key]' " \
+            '(got %r)' % (anchor,)
+    if lg.get('enabled'):
+        assert srv.get('publish'), \
+            'league.enabled requires serving.publish (pool members ARE the ' \
+            "registry line's versions)"
     par = ta.get('parallel') or {}
     assert int(par.get('model_parallel', 1)) >= 1, \
         'parallel.model_parallel must be >= 1 (1 = no tensor parallelism)'

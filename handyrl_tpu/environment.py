@@ -23,6 +23,7 @@ ENVS = {
     'ParallelTicTacToe': 'handyrl_tpu.envs.parallel_tictactoe',
     'Geister': 'handyrl_tpu.envs.geister',
     'HungryGeese': 'handyrl_tpu.envs.kaggle.hungry_geese',
+    'ConnectX': 'handyrl_tpu.envs.kaggle.connectx',
 }
 
 # Pure-JAX twins: envs re-implemented as jittable array functions for

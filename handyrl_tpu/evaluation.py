@@ -273,8 +273,15 @@ class Evaluator:
         return opponents[idx]
 
     def execute(self, models: Dict[int, Any], eval_args) -> Optional[dict]:
-        opponents = self.args.get('eval', {}).get('opponent', [])
-        opponent = self._draw_opponent(opponents, eval_args)
+        # a server-stamped opponent (league rating matches, train.py)
+        # overrides the local pool draw: the task says exactly who to
+        # meet. Registry-member opponents arrive as seated model_ids
+        # (every seat's model is non-None, so the name is only the
+        # result label); anchor names resolve below like any pool spec.
+        opponent = (eval_args or {}).get('opponent')
+        if not opponent:
+            opponents = self.args.get('eval', {}).get('opponent', [])
+            opponent = self._draw_opponent(opponents, eval_args)
 
         agents = {p: Agent(model) if model is not None
                   else self._opponent_agent(opponent)

@@ -101,7 +101,14 @@ class TaskLedger:
     # -- assignment / completion --
 
     def assign(self, endpoint, role_args: Dict[str, Any]) -> int:
-        """Book ``role_args`` against ``endpoint`` and stamp its task_id."""
+        """Book ``role_args`` against ``endpoint`` and stamp its task_id.
+
+        The booked copy is the FULL role_args (deep-copied, minus the
+        task_id): a re-issue replays it verbatim, so server-stamped fields
+        like the league's opponent assignment (``league_opponent`` /
+        ``league_seat`` / ``opponent``, train.py server()) survive a
+        stranded task bit-identically — the replacement worker plays the
+        same member, and rating accounting never double-books a draw."""
         tid, self._next_tid = self._next_tid, self._next_tid + 1
         base = copy.deepcopy(
             {k: v for k, v in role_args.items() if k != 'task_id'})

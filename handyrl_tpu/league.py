@@ -1,0 +1,374 @@
+"""League training: population orchestration over the model registry.
+
+The learner owns a :class:`LeaguePool` that turns the versioned
+:class:`~handyrl_tpu.serving.registry.ModelRegistry` into an opponent
+*population*.  Pool members are registry versions of the configured line
+(named ``line@version``) plus built-in anchors (``random``, and
+``rulebase``/``rulebase-*`` for environments that implement
+``rule_based_action``).  PFSP-style opponent sampling weights registry
+members by a configurable curve over the learner's empirical win rate
+against each member:
+
+* ``variance`` — weight ∝ p·(1−p): prefers opponents the learner is
+  ~50/50 against (maximum learning signal), the PFSP default.
+* ``hard``     — weight ∝ (1−p)^k: prefers opponents the learner loses
+  to (``k`` = ``league.hard_exponent``).
+* ``uniform``  — every member equally likely.
+
+Draws are routed through the audited :func:`~handyrl_tpu.generation.sample_seed`
+machinery keyed on ``(seed, sample_key)`` (episode-key namespace ``3``),
+so opponent assignment is a pure function of the task: byte-identical
+across ledger re-issues and independent of wall clock or process
+identity (GL001-clean — no raw ``random`` in the record path).
+
+A persistent :class:`RatingBook` maintains an Elo rating per member
+(optionally a TrueSkill-lite ``sigma`` that shrinks with games and
+scales the effective K-factor), updated from ``'g'`` episode outcomes
+and from dedicated rating matches scheduled as a slice of ``'e'``
+tasks.  The book is journaled atomically via
+:func:`handyrl_tpu.utils.fs.atomic_write_bytes` so ratings survive
+learner restart/preemption bit-identically, and it gates champion
+promotion: the registry champion flips only when the candidate's
+rating clears the incumbent member's by ``league.promote_margin`` with
+at least ``league.min_games`` games since the last flip.
+"""
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .generation import sample_seed
+from .utils.fs import atomic_write_bytes
+
+# Episode-key namespace for league opponent draws (0 = server-stamped
+# generation episodes, 1 = worker-local fallback, 2 = evaluator opponent
+# draws — see generation.py / evaluation.py).
+LEAGUE_SEED_NAMESPACE = 3
+
+# RatingBook entry name for the learner (the live training model).
+LEARNER = 'learner'
+
+# Anchor members that need no checkpoint.  ``random`` plays uniformly
+# over legal actions (ModelVault serves it as model_id 0 for 'g' tasks);
+# ``rulebase`` anchors call the environment's rule_based_action and can
+# therefore only be exercised through 'e' rating matches (worker-side
+# agents), never as a 'g' seat.
+RANDOM_ANCHOR = 'random'
+
+PFSP_CURVES = ('variance', 'hard', 'uniform')
+
+# Floor added to every PFSP weight so no member's sampling probability
+# collapses to zero (a member at p=1.0 must stay reachable, both to
+# detect regressions and to keep its rating current).
+_WEIGHT_FLOOR = 0.01
+
+
+def pfsp_weights(win_rates: Sequence[float], curve: str = 'variance',
+                 hard_exponent: float = 2.0) -> np.ndarray:
+    """Unnormalized PFSP sampling weights for a vector of win rates.
+
+    ``win_rates[i]`` is the learner's empirical win probability against
+    member ``i`` (0.5 for unplayed members).  Returns a strictly
+    positive float64 vector of the same length."""
+    p = np.clip(np.asarray(win_rates, dtype=np.float64), 0.0, 1.0)
+    if curve == 'variance':
+        w = p * (1.0 - p)
+    elif curve == 'hard':
+        w = (1.0 - p) ** float(hard_exponent)
+    elif curve == 'uniform':
+        w = np.ones_like(p)
+    else:
+        raise ValueError('unknown PFSP curve %r (expected one of %s)'
+                         % (curve, ', '.join(PFSP_CURVES)))
+    return w + _WEIGHT_FLOOR
+
+
+def member_name(line: str, version: Any) -> str:
+    return '%s@%s' % (line, version)
+
+
+def split_member(name: str) -> Tuple[Optional[str], Optional[str]]:
+    """``'line@version' -> (line, version)``; anchors return (None, None)."""
+    if '@' not in name:
+        return None, None
+    line, _, version = name.rpartition('@')
+    return line, version
+
+
+class RatingBook:
+    """Persistent Elo ratings for the learner and every pool member.
+
+    Entries are ``{'rating', 'sigma', 'games', 'wins'}``; ``wins``
+    accumulates fractional scores (draw = 0.5).  All updates are pure
+    float arithmetic on the stored state, so a journal round-trip
+    reproduces subsequent updates bit-identically."""
+
+    def __init__(self, initial_rating: float = 1200.0,
+                 k_factor: float = 32.0, track_sigma: bool = True,
+                 initial_sigma: float = 200.0, min_sigma: float = 50.0):
+        self.initial_rating = float(initial_rating)
+        self.k_factor = float(k_factor)
+        self.track_sigma = bool(track_sigma)
+        self.initial_sigma = float(initial_sigma)
+        self.min_sigma = float(min_sigma)
+        self._entries: Dict[str, Dict[str, float]] = {}
+        # Games credited to the learner since the last champion flip —
+        # the denominator of the league.min_games promotion gate.
+        self.games_since_promote = 0
+        self.promotions = 0
+
+    # -- entries ---------------------------------------------------------
+
+    def entry(self, name: str) -> Dict[str, float]:
+        e = self._entries.get(name)
+        if e is None:
+            e = {'rating': self.initial_rating,
+                 'sigma': self.initial_sigma, 'games': 0, 'wins': 0.0}
+            self._entries[name] = e
+        return e
+
+    def seed(self, name: str, rating: float) -> None:
+        """Create ``name`` with a starting rating (fresh sigma, no games)."""
+        self._entries[name] = {'rating': float(rating),
+                               'sigma': self.initial_sigma,
+                               'games': 0, 'wins': 0.0}
+
+    def rating(self, name: str) -> float:
+        e = self._entries.get(name)
+        return self.initial_rating if e is None else float(e['rating'])
+
+    def games(self, name: str) -> int:
+        e = self._entries.get(name)
+        return 0 if e is None else int(e['games'])
+
+    def win_rate(self, name: str) -> float:
+        """Learner's empirical win rate against ``name`` (0.5 unplayed)."""
+        e = self._entries.get(name)
+        if e is None or e['games'] <= 0:
+            return 0.5
+        return float(e['wins']) / float(e['games'])
+
+    def names(self) -> List[str]:
+        return sorted(self._entries)
+
+    # -- updates ---------------------------------------------------------
+
+    def _k(self, e: Dict[str, float]) -> float:
+        if not self.track_sigma:
+            return self.k_factor
+        scale = max(float(e['sigma']) / self.initial_sigma, 0.25)
+        return self.k_factor * scale
+
+    def _shrink(self, e: Dict[str, float]) -> None:
+        if self.track_sigma:
+            e['sigma'] = max(self.min_sigma,
+                             self.initial_sigma
+                             / math.sqrt(1.0 + float(e['games']) / 8.0))
+
+    def record(self, opponent: str, score: float) -> None:
+        """Book one game: learner scored ``score`` ∈ [0, 1] vs ``opponent``.
+
+        Standard Elo with per-side effective K (scaled by sigma when
+        TrueSkill-lite tracking is on); the opponent entry moves by the
+        mirrored delta, and per-opponent (games, wins) feed the PFSP
+        win-rate curve."""
+        s = min(max(float(score), 0.0), 1.0)
+        learner = self.entry(LEARNER)
+        member = self.entry(opponent)
+        expected = 1.0 / (1.0 + 10.0 ** ((member['rating']
+                                          - learner['rating']) / 400.0))
+        learner['rating'] += self._k(learner) * (s - expected)
+        member['rating'] += self._k(member) * ((1.0 - s) - (1.0 - expected))
+        learner['games'] += 1
+        learner['wins'] += s
+        member['games'] += 1
+        member['wins'] += s  # learner's score vs this member (PFSP input)
+        self._shrink(learner)
+        self._shrink(member)
+        self.games_since_promote += 1
+
+    def note_promotion(self) -> None:
+        self.promotions += 1
+        self.games_since_promote = 0
+
+    # -- persistence -----------------------------------------------------
+
+    def to_state(self) -> Dict[str, Any]:
+        return {'entries': {k: dict(v) for k, v in self._entries.items()},
+                'games_since_promote': self.games_since_promote,
+                'promotions': self.promotions,
+                'initial_rating': self.initial_rating}
+
+    def from_state(self, state: Dict[str, Any]) -> None:
+        self._entries = {k: dict(v)
+                         for k, v in (state.get('entries') or {}).items()}
+        self.games_since_promote = int(state.get('games_since_promote', 0))
+        self.promotions = int(state.get('promotions', 0))
+
+    def save(self, path: str) -> None:
+        """Atomic journal write (temp + fsync + rename via utils.fs)."""
+        payload = json.dumps(self.to_state(), sort_keys=True) + '\n'
+        atomic_write_bytes(path, payload.encode('utf-8'))
+
+    def load(self, path: str) -> bool:
+        """Reload a journal written by :meth:`save`; False if absent."""
+        try:
+            with open(path, 'rb') as f:
+                raw = f.read()
+        except OSError:
+            return False
+        try:
+            self.from_state(json.loads(raw.decode('utf-8')))
+        except (ValueError, UnicodeDecodeError):
+            return False
+        return True
+
+
+class LeaguePool:
+    """The opponent population: registry members plus built-in anchors.
+
+    Refreshed from the registry manifest at epoch boundaries; the
+    member window keeps the champion, the rollback target, and the
+    ``max_members`` newest versions of the line.  Sampling is
+    deterministic per ``(seed, sample_key)`` (see module docstring)."""
+
+    def __init__(self, league_args: Dict[str, Any], line: str):
+        self.args = dict(league_args or {})
+        self.line = line
+        self.curve = self.args.get('curve', 'variance')
+        self.hard_exponent = float(self.args.get('hard_exponent', 2.0))
+        self.max_members = int(self.args.get('max_members', 8))
+        self.anchors = list(self.args.get('anchors', [RANDOM_ANCHOR]))
+        self.self_play_rate = float(self.args.get('self_play_rate', 0.5))
+        # name -> absolute checkpoint path (registry members only)
+        self._member_paths: Dict[str, str] = {}
+        # name -> int version id usable as a 'g' task model_id
+        self._member_ids: Dict[str, int] = {}
+        self.champion: Optional[str] = None
+
+    # -- membership ------------------------------------------------------
+
+    def refresh(self, registry) -> None:
+        """Rebuild the member window from the registry manifest."""
+        entry = (registry.describe() or {}).get(self.line) or {}
+        versions = entry.get('versions') or {}
+        order = sorted(versions,
+                       key=lambda v: int(versions[v].get('seq', 0)))
+        keep = set(order[-self.max_members:])
+        for special in (entry.get('champion'), entry.get('previous')):
+            if special is not None:
+                keep.add(special)
+        paths, ids = {}, {}
+        for vid in keep:
+            meta = versions.get(vid)
+            if meta is None:
+                continue
+            name = member_name(self.line, vid)
+            paths[name] = meta['path']
+            try:
+                ids[name] = int(vid)
+            except (TypeError, ValueError):
+                pass  # non-numeric version: usable via 'e' specs only
+        self._member_paths = paths
+        self._member_ids = ids
+        champ = entry.get('champion')
+        self.champion = (member_name(self.line, champ)
+                         if champ is not None else None)
+
+    def members(self) -> List[str]:
+        """Registry members, sorted (stable draw order)."""
+        return sorted(self._member_paths)
+
+    def roster(self) -> List[str]:
+        """Members plus anchors — everything the RatingBook tracks."""
+        return self.members() + list(self.anchors)
+
+    def member_paths(self) -> Set[str]:
+        """Checkpoint paths the GC must pin while membership lasts."""
+        return set(self._member_paths.values())
+
+    def member_model_id(self, name: str) -> Optional[int]:
+        """The model_id a 'g' task carries for this member's seats:
+        the registry version id for members, 0 (uniform-random model)
+        for the ``random`` anchor, None for members a worker cannot
+        realize as a model (rulebase anchors, non-numeric versions)."""
+        if name == RANDOM_ANCHOR:
+            return 0
+        return self._member_ids.get(name)
+
+    # -- sampling --------------------------------------------------------
+
+    def gen_candidates(self) -> List[str]:
+        """Members a 'g' episode can seat: anything with a model_id."""
+        out = [m for m in self.members() if m in self._member_ids]
+        if RANDOM_ANCHOR in self.anchors:
+            out.append(RANDOM_ANCHOR)
+        return out
+
+    def sample_opponent(self, base_seed: int, sample_key: int,
+                        ratings: RatingBook) -> Optional[str]:
+        """PFSP draw for the 'g' task stamped ``sample_key``.
+
+        Returns None for the self-play share (probability
+        ``self_play_rate``) and when no candidate exists.  Both the
+        self-play coin and the member draw consume the same audited
+        seed sequence (namespace 3, draw indices 0 and 1), so the
+        assignment is a pure function of ``(seed, sample_key)``."""
+        candidates = self.gen_candidates()
+        if not candidates:
+            return None
+        key = (LEAGUE_SEED_NAMESPACE, int(sample_key))
+        coin = np.random.default_rng(
+            sample_seed(base_seed, key, 0)).random()
+        if coin < self.self_play_rate:
+            return None
+        weights = pfsp_weights([ratings.win_rate(m) for m in candidates],
+                               self.curve, self.hard_exponent)
+        probs = weights / weights.sum()
+        u = np.random.default_rng(sample_seed(base_seed, key, 1)).random()
+        idx = min(int(np.searchsorted(np.cumsum(probs), u, side='right')),
+                  len(candidates) - 1)
+        return candidates[idx]
+
+    def rating_opponent(self, counter: int) -> Optional[str]:
+        """Deterministic round-robin over the full roster for rating
+        matches (the 'e' slice) — coverage, not exploration, so no RNG:
+        every member and anchor gets rated at the same cadence."""
+        roster = self.roster()
+        if not roster:
+            return None
+        return roster[int(counter) % len(roster)]
+
+    # -- promotion gate --------------------------------------------------
+
+    def should_promote(self, ratings: RatingBook) -> bool:
+        """True when the learner's rating clears the incumbent champion
+        member's by ``promote_margin`` with ≥ ``min_games`` games booked
+        since the last flip.  With no champion yet the registry's
+        bootstrap auto-promotion handles the first version."""
+        if self.champion is None:
+            return False
+        margin = float(self.args.get('promote_margin', 30.0))
+        min_games = int(self.args.get('min_games', 20))
+        if ratings.games_since_promote < min_games:
+            return False
+        return (ratings.rating(LEARNER)
+                >= ratings.rating(self.champion) + margin)
+
+
+def journal_path(root: str) -> str:
+    """Default RatingBook journal location under the registry root."""
+    return os.path.join(root, 'league_ratings.json')
+
+
+def make_rating_book(league_args: Dict[str, Any]) -> RatingBook:
+    lg = league_args or {}
+    return RatingBook(
+        initial_rating=float(lg.get('initial_rating', 1200.0)),
+        k_factor=float(lg.get('k_factor', 32.0)),
+        track_sigma=bool(lg.get('track_sigma', True)),
+        initial_sigma=float(lg.get('initial_sigma', 200.0)),
+        min_sigma=float(lg.get('min_sigma', 50.0)))

@@ -21,7 +21,8 @@ def register(name: str):
 def build(name: str, **kwargs):
     if name not in _REGISTRY:
         # lazily import the built-in model modules, which self-register
-        from . import tictactoe, geister, geese, transformer  # noqa: F401
+        from . import (tictactoe, geister, geese, transformer,  # noqa: F401
+                       connect_four)
     return _REGISTRY[name](**kwargs)
 
 

@@ -1032,6 +1032,13 @@ BUILTIN_ALERTS: Tuple[Dict[str, Any], ...] = (
     {'name': 'retrace_storm',
      'metric': 'xla_retraces_total', 'kind': 'rate',
      'op': '>', 'threshold': 0.0, 'clear_for': 60.0},
+    # league plane (docs/league.md): a pool that stops booking rated games
+    # starves PFSP and freezes the promotion gate — armed only once the
+    # first league game ever lands, so non-league runs stay silent
+    {'name': 'league_rating_stall',
+     'metric': 'league_games_total', 'kind': 'rate',
+     'op': '<=', 'threshold': 0.0, 'for': 120.0,
+     'arm_metric': 'league_games_total'},
 )
 
 _ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {

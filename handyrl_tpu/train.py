@@ -45,6 +45,7 @@ import numpy as np
 import psutil
 
 from . import guard as guard_mod
+from . import league as league_mod
 from . import telemetry
 from .environment import make_env, prepare_env
 from .fault import FleetController, TaskLedger
@@ -1237,6 +1238,36 @@ class Learner:
         self._trainer_thread: Optional[threading.Thread] = None
         self._registry = None   # lazy ModelRegistry (serving.publish)
 
+        # league training (league.py, docs/league.md): the pool, the
+        # persistent rating book, and the per-epoch opponents-sampled
+        # tally. Everything below is None with league.enabled false, so
+        # task assignment/records/metrics stay byte-identical to the
+        # pre-league behavior.
+        lg = dict(args.get('league') or {})
+        self._league: Optional[league_mod.LeaguePool] = None
+        self._league_ratings: Optional[league_mod.RatingBook] = None
+        self._league_journal = ''
+        self._league_sampled: Dict[str, int] = {}
+        if lg.get('enabled'):
+            srv = args.get('serving') or {}
+            line = str(lg.get('line') or srv.get('line', 'default'))
+            self._league = league_mod.LeaguePool(lg, line)
+            self._league_ratings = league_mod.make_rating_book(lg)
+            self._league_journal = league_mod.journal_path(
+                self._registry_root())
+            if self._league_ratings.load(self._league_journal):
+                print('league: reloaded ratings journal (%d entries, %d '
+                      'promotions)' % (len(self._league_ratings.names()),
+                                       self._league_ratings.promotions))
+            try:
+                self._league.refresh(self._ensure_registry())
+            except Exception as exc:   # fresh run: no manifest yet
+                _LOG.debug('league: initial pool refresh skipped (%s)', exc)
+            if self.use_batched_generation:
+                _LOG.warning('league.enabled only drives the worker-fleet '
+                             "server() task assignment; the in-process "
+                             'batched generator keeps mirror self-play')
+
         # the scrape endpoint binds only once everything it reads (trainer,
         # worker front-end) exists — a scrape can land any time after this
         export_port = int(args.get('telemetry_port') or 0)
@@ -1319,12 +1350,21 @@ class Learner:
         srv = self.args.get('serving') or {}
         return srv.get('registry_dir') or self.args.get('model_dir', 'models')
 
+    def _ensure_registry(self):
+        if self._registry is None:
+            from .serving.registry import ModelRegistry
+            self._registry = ModelRegistry(self._registry_root())
+        return self._registry
+
     def _publish_checkpoint(self, steps: int):
         """``serving.publish``: register the just-written numbered
         checkpoint with the ModelRegistry as ``<line>@<epoch>`` (pinning it
         against ``keep_checkpoints`` GC); ``serving.auto_promote`` also
-        makes it the line's champion in the same atomic manifest swap. A
-        registry failure is loud but never takes training down."""
+        makes it the line's champion in the same atomic manifest swap —
+        unless the league owns promotion (league.enabled), in which case
+        versions publish as candidates and the champion only flips through
+        the rating gate (:meth:`_league_epoch_sync`). A registry failure is
+        loud but never takes training down."""
         srv = self.args.get('serving') or {}
         if not srv.get('publish'):
             return
@@ -1334,18 +1374,65 @@ class Learner:
         try:
             from . import models as model_zoo
             from .model import module_config
+            promote = bool(srv.get('auto_promote', True))
+            if getattr(self, '_league', None) is not None:
+                # rating-gated promotion replaces recency auto_promote
+                # (the registry still bootstraps the FIRST version as
+                # champion — a line must never be headless)
+                promote = False
             self._registry.publish(
                 str(srv.get('line', 'default')),
                 path=self.model_path(self.model_epoch),
                 architecture=model_zoo.architecture_name(self.wrapper.module),
                 config=module_config(self.wrapper.module) or None,
                 steps=int(steps), version=self.model_epoch,
-                promote=bool(srv.get('auto_promote', True)))
+                promote=promote)
         except Exception as exc:
             _LOG.error('registry publish of epoch %d failed (%s: %s); '
                        'training continues unpublished', self.model_epoch,
                        type(exc).__name__, str(exc)[:200])
             telemetry.counter('registry_publish_failures_total').inc()
+        sync = getattr(self, '_league_epoch_sync', None)
+        if sync is not None:
+            sync()
+
+    def _league_epoch_sync(self):
+        """League epoch boundary (after publish, before retention GC):
+        refresh the member window from the registry manifest, run the
+        rating-gated promotion, export the rating gauges, and journal the
+        book atomically. Failures are loud but never take training down."""
+        if getattr(self, '_league', None) is None \
+                or self._league_ratings is None:
+            return
+        book = self._league_ratings
+        try:
+            reg = self._ensure_registry()
+            self._league.refresh(reg)
+            # a fresh member is a snapshot of the learner: seed it at the
+            # learner's current rating instead of the cold initial_rating
+            known = set(book.names())
+            for m in self._league.members():
+                if m not in known:
+                    book.seed(m, book.rating(league_mod.LEARNER))
+            if self._league.should_promote(book):
+                incumbent = self._league.champion
+                reg.promote(self._league.line, self.model_epoch)
+                book.note_promotion()
+                telemetry.counter('league_promotions_total').inc()
+                self._league.refresh(reg)
+                print('league: promoted %s@%d (learner %.1f vs incumbent '
+                      '%s %.1f)' % (self._league.line, self.model_epoch,
+                                    book.rating(league_mod.LEARNER),
+                                    incumbent,
+                                    book.rating(incumbent)
+                                    if incumbent else float('nan')))
+            for name in set(self._league.roster()) | set(book.names()):
+                telemetry.gauge('league_rating', member=name).set(
+                    round(book.rating(name), 2))
+            book.save(self._league_journal)
+        except Exception as exc:
+            _LOG.error('league: epoch sync failed (%s: %s); training '
+                       'continues', type(exc).__name__, str(exc)[:200])
 
     # -- checkpoint integrity / retention / rollback -----------------------
     def _load_resume_params(self):
@@ -1485,6 +1572,13 @@ class Learner:
         pinned = pinned_checkpoint_paths(self._registry_root())
         if pinned is None:
             return   # corrupt manifest: conservatively collect nothing
+        if getattr(self, '_league', None) is not None:
+            # league-pool members must outlive the retention window for as
+            # long as PFSP can sample them (the member window can trail
+            # keep_checkpoints); counted via guard_ckpt_gc_pinned_total
+            # like any registry pin
+            pinned = pinned | {os.path.abspath(p)
+                               for p in self._league.member_paths()}
         protected = {os.path.abspath(o)
                      for o in (self.args.get('eval', {}).get('opponent') or [])
                      if isinstance(o, str) and os.path.exists(o)}
@@ -1542,6 +1636,11 @@ class Learner:
         count (what resume will restore), not the live trainer counter —
         the JSONL step sequence stays monotonic across the restart."""
         telemetry.counter('guard_preemptions_total').inc()
+        if getattr(self, '_league_ratings', None) is not None \
+                and self._league_journal:
+            # the ratings journal rides the preemption flush: the restart
+            # reloads it bit-identically (atomic write, sorted keys)
+            self._league_ratings.save(self._league_journal)
         steps = max(self._last_ckpt_steps, 0)
         self._write_metrics(steps, extra={
             'preempted': True, 'signal': int(self.preempt.signum or 0)})
@@ -1583,6 +1682,7 @@ class Learner:
                 n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
                 self.generation_results[model_id] = (n + 1, r + outcome,
                                                      r2 + outcome ** 2)
+            self._league_observe_episode(episode)
             self.num_returned_episodes += 1
             if self.num_returned_episodes % 100 == 0:
                 # complete line at debug level, not a bare dot stream that
@@ -1666,6 +1766,104 @@ class Learner:
                 opponent = result['opponent']
                 n, r, r2 = opp_map.get(opponent, (0, 0, 0))
                 opp_map[opponent] = (n + 1, r + res, r2 + res ** 2)
+            self._league_observe_result(result)
+
+    # -- league plumbing --------------------------------------------------
+    def _league_gen_opponent(self, sample_key: int):
+        """PFSP draw for the 'g' task stamped ``sample_key``: the
+        ``(member, model_id)`` the opponent seats carry, or None for the
+        self-play share / an empty pool. Deterministic per (seed,
+        sample_key) — a ledger re-issue keeps the assignment anyway (the
+        ledger replays the booked role_args verbatim, fault.py)."""
+        if getattr(self, '_league', None) is None \
+                or self._league_ratings is None:
+            return None
+        member = self._league.sample_opponent(
+            int(self.args.get('seed') or 0), sample_key, self._league_ratings)
+        if member is None:
+            return None
+        mid = self._league.member_model_id(member)
+        if mid is None:
+            return None
+        return member, mid
+
+    def _league_rating_opponent(self, counter: int):
+        """Round-robin rating-match opponent for the 'e' slice, or None
+        when this slot stays a configured-pool eval match."""
+        if getattr(self, '_league', None) is None:
+            return None
+        rate = float(self._league.args.get('rating_match_rate', 0.25))
+        if rate <= 0.0:
+            return None
+        # every ceil(1/rate)-th 'e' task becomes a rating match — a
+        # deterministic stride, not a draw: coverage is the goal here
+        stride = max(1, int(round(1.0 / rate)))
+        if counter % stride != 0:
+            return None
+        return self._league.rating_opponent(counter // stride)
+
+    def _league_model_snapshot(self, model_id) -> Optional[dict]:
+        """'model' RPC fallback: resolve a league member version through
+        the registry manifest (CRC-verified load) when the numbered
+        checkpoint is gone from model_dir. None when the league is off or
+        the registry cannot produce the version either."""
+        if getattr(self, '_league', None) is None:
+            return None
+        try:
+            snap = self._ensure_registry().load_snapshot(
+                self._league.line, str(model_id))
+            return {k: snap[k] for k in ('architecture', 'params', 'config')
+                    if k in snap}
+        except Exception as exc:
+            _LOG.warning('league: registry could not resolve model %s '
+                         '(%s: %s)', model_id, type(exc).__name__,
+                         str(exc)[:120])
+            return None
+
+    def _league_observe_episode(self, episode: dict):
+        """Book a league 'g' outcome: the learner's score vs the member
+        the server seated (stamped league_opponent/league_seat)."""
+        if getattr(self, '_league_ratings', None) is None:
+            return
+        args = episode.get('args') or {}
+        member = args.get('league_opponent')
+        if not member:
+            return
+        outcome = episode['outcome'].get(args.get('league_seat'))
+        if outcome is None:
+            return
+        self._league_ratings.record(member, (float(outcome) + 1.0) / 2.0)
+        self._league_sampled[member] = self._league_sampled.get(member, 0) + 1
+        telemetry.counter('league_games_total').inc()
+
+    def _league_observe_result(self, result: dict):
+        """Book a league rating match ('e' slice): the evaluated seat's
+        result vs the member named by the task's opponent override."""
+        if getattr(self, '_league_ratings', None) is None:
+            return
+        args = result.get('args') or {}
+        if not args.get('league_rating_match'):
+            return
+        member = result.get('opponent')
+        seats = args.get('player') or []
+        if not member or not seats:
+            return
+        res = result['result'].get(seats[0])
+        if res is None:
+            return
+        self._league_ratings.record(member, (float(res) + 1.0) / 2.0)
+        telemetry.counter('league_games_total').inc()
+
+    def _print_league_stats(self):
+        if getattr(self, '_league', None) is None \
+                or self._league_ratings is None:
+            return
+        book = self._league_ratings
+        print('league: learner=%.1f games=%d members=%d champion=%s '
+              'promotions=%d'
+              % (book.rating(league_mod.LEARNER), book.games_since_promote,
+                 len(self._league.members()), self._league.champion,
+                 book.promotions))
 
     # -- telemetry plumbing ----------------------------------------------
     def _telemetry_snapshots(self) -> List[dict]:
@@ -1758,6 +1956,7 @@ class Learner:
         print('epoch %d' % self.model_epoch)
         self._print_eval_stats()
         self._print_generation_stats()
+        self._print_league_stats()
 
         with telemetry.span('epoch_update'):
             params, steps, state_blob = self.trainer.update()
@@ -1790,6 +1989,30 @@ class Learner:
         if ev:
             n, r, _ = ev
             rec['win_rate'] = (r / (n + 1e-6) + 1) / 2
+        # per-opponent rows ride EVERY record (the console line still
+        # collapses a 1-opponent pool to the reference format): with a
+        # league pool the aggregate win rate hides exactly the per-member
+        # signal the ratings are built from
+        ev_opp = self.results_per_opponent.get(self.model_epoch - 1)
+        if ev_opp:
+            rec['eval_opponents'] = {
+                name: {'games': n,
+                       'win_rate': round((r / (n + 1e-6) + 1) / 2, 4)}
+                for name, (n, r, _r2) in sorted(ev_opp.items())}
+        if getattr(self, '_league', None) is not None \
+                and self._league_ratings is not None:
+            book = self._league_ratings
+            names = sorted(set(book.names()) | set(self._league.roster()))
+            rec['league'] = {
+                'champion': self._league.champion,
+                'members': self._league.members(),
+                'ratings': {n: round(book.rating(n), 2) for n in names},
+                'games': {n: book.games(n) for n in names},
+                'games_since_promote': book.games_since_promote,
+                'promotions': book.promotions,
+                'opponents_sampled': dict(sorted(
+                    self._league_sampled.items())),
+            }
         # fast runs see only a handful of eval games per epoch (an epoch can
         # last ~2s); a trailing-window aggregate keeps the quality curve
         # readable from the JSONL alone
@@ -2561,9 +2784,28 @@ class Learner:
                                 role_args['role'] = 'g'
 
                             if role_args['role'] == 'g':
-                                role_args['player'] = self.env.players()
-                                for p in self.env.players():
+                                players = self.env.players()
+                                role_args['player'] = players
+                                for p in players:
                                     role_args['model_id'][p] = self.model_epoch
+                                # league (league.py): the PFSP share seats
+                                # a pool member on every non-learner seat;
+                                # the learner seat rotates so first-mover
+                                # advantage cancels over the stream. The
+                                # stamped league_opponent/league_seat ride
+                                # the ledger's booked role_args, so a
+                                # re-issue keeps the exact assignment.
+                                drawn = self._league_gen_opponent(
+                                    self.num_episodes)
+                                if drawn is not None:
+                                    member, mid = drawn
+                                    seat = players[
+                                        self.num_episodes % len(players)]
+                                    for p in players:
+                                        if p != seat:
+                                            role_args['model_id'][p] = mid
+                                    role_args['league_opponent'] = member
+                                    role_args['league_seat'] = seat
                                 # the action-sampling key: with it, the
                                 # episode is a pure function of (seed,
                                 # sample_key, params) — identical on the
@@ -2580,6 +2822,22 @@ class Learner:
                                     role_args['model_id'][p] = (
                                         self.model_epoch if p in role_args['player']
                                         else -1)
+                                # league rating matches: a deterministic
+                                # slice of 'e' tasks pins its opponent to a
+                                # round-robin roster member (the worker's
+                                # Evaluator honors the stamped override);
+                                # registry members ride as model_id seats,
+                                # anchors resolve worker-side by name
+                                member = self._league_rating_opponent(
+                                    self.num_results)
+                                if member is not None:
+                                    role_args['opponent'] = member
+                                    role_args['league_rating_match'] = True
+                                    mid = self._league.member_model_id(member)
+                                    if mid is not None and mid > 0:
+                                        for p in players:
+                                            if p not in role_args['player']:
+                                                role_args['model_id'][p] = mid
                                 role_args['sample_key'] = self.num_results
                                 self.num_results += 1
                         ledger.assign(conn, role_args)
@@ -2614,7 +2872,12 @@ class Learner:
                             if config:
                                 snap['config'] = config
                         except OSError:
-                            snap = self.wrapper.snapshot()
+                            # league members can outlive model_dir (GC'd
+                            # numbered ckpt, registry-owned bytes): resolve
+                            # the version through the registry manifest
+                            # before falling back to the live snapshot
+                            snap = (self._league_model_snapshot(model_id)
+                                    or self.wrapper.snapshot())
                     send_data.append(snap)
 
             if not multi_req and len(send_data) == 1:

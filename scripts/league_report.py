@@ -1,0 +1,148 @@
+"""Render a league run's rating table and promotion history from its
+metrics_jsonl stream (docs/league.md).
+
+Every learner metrics record written with ``league.enabled`` carries a
+``league`` block (champion, per-name ratings and games, promotion
+counters, opponent-sampling tallies). This report replays those blocks
+and prints:
+
+  * the final rating table, sorted by rating, with games and the
+    learner/champion/anchor markers;
+  * the promotion history — every record where the promotion counter
+    moved, with the champion it installed;
+  * cumulative PFSP opponent-sampling tallies (per run_id the in-memory
+    tally resets on restart, so tallies are summed per run).
+
+``--journal`` additionally reads the ``league_ratings.json`` book for
+the sigma column (the JSONL rounds ratings; the journal is exact).
+
+Usage: python scripts/league_report.py metrics.jsonl [--journal PATH]
+Exits 1 when the stream has no league blocks. Stdlib only.
+"""
+
+import argparse
+import json
+import sys
+
+
+def read_league_records(path):
+    """[(record, league block)] for every record carrying one."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue    # torn tail line: the writer died mid-record
+            lg = rec.get('league')
+            if lg:
+                out.append((rec, lg))
+    return out
+
+
+def promotion_history(records):
+    """[(epoch, champion, promotions)] at every promotion-counter move."""
+    history = []
+    last = None
+    for rec, lg in records:
+        p = int(lg.get('promotions') or 0)
+        if last is not None and p > last:
+            history.append((rec.get('epoch'), lg.get('champion'), p))
+        last = p
+    return history
+
+
+def sampling_totals(records):
+    """Cumulative opponent draws: per-run tallies reset on restart, so
+    take each run's high-water mark and sum across runs."""
+    per_run = {}
+    for rec, lg in records:
+        run = per_run.setdefault(rec.get('run_id', ''), {})
+        for name, n in (lg.get('opponents_sampled') or {}).items():
+            run[name] = max(run.get(name, 0), int(n))
+    totals = {}
+    for run in per_run.values():
+        for name, n in run.items():
+            totals[name] = totals.get(name, 0) + n
+    return totals
+
+
+def render(records, journal=None, out=sys.stdout):
+    rec, lg = records[-1]
+    sigmas = {}
+    if journal:
+        entries = (journal.get('entries') or {})
+        sigmas = {k: v.get('sigma') for k, v in entries.items()}
+    champion = lg.get('champion')
+    ratings = lg.get('ratings') or {}
+    games = lg.get('games') or {}
+    members = set(lg.get('members') or [])
+
+    print('league report: epoch %s, %d league records'
+          % (rec.get('epoch'), len(records)), file=out)
+    print('champion: %s  promotions: %s  games_since_promote: %s'
+          % (champion, lg.get('promotions'), lg.get('games_since_promote')),
+          file=out)
+    print(file=out)
+    header = '%-24s %10s %8s %7s  %s' % ('name', 'rating', 'sigma',
+                                         'games', 'role')
+    print(header, file=out)
+    print('-' * len(header), file=out)
+    for name in sorted(ratings, key=lambda n: -float(ratings[n])):
+        if name == 'learner':
+            role = 'learner'
+        elif name == champion:
+            role = 'champion'
+        elif name in members:
+            role = 'member'
+        else:
+            role = 'anchor'
+        sigma = sigmas.get(name)
+        print('%-24s %10.1f %8s %7d  %s'
+              % (name, float(ratings[name]),
+                 '%.1f' % sigma if sigma is not None else '-',
+                 int(games.get(name, 0)), role), file=out)
+
+    history = promotion_history(records)
+    print(file=out)
+    if history:
+        print('promotions:', file=out)
+        for epoch, champ, count in history:
+            print('  epoch %-5s -> %s (total %d)' % (epoch, champ, count),
+                  file=out)
+    else:
+        print('promotions: none recorded in this stream', file=out)
+
+    totals = sampling_totals(records)
+    if totals:
+        print(file=out)
+        print('opponents sampled (PFSP draws):', file=out)
+        for name in sorted(totals, key=lambda n: -totals[n]):
+            print('  %-24s %6d' % (name, totals[name]), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('metrics', help='metrics_jsonl path from a league run')
+    ap.add_argument('--journal', default='',
+                    help='league_ratings.json for the exact sigma column')
+    args = ap.parse_args(argv)
+
+    records = read_league_records(args.metrics)
+    if not records:
+        print('league_report: no league blocks in %s (league.enabled run?)'
+              % args.metrics, file=sys.stderr)
+        return 1
+    journal = None
+    if args.journal:
+        with open(args.journal) as f:
+            journal = json.load(f)
+    render(records, journal)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

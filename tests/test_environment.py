@@ -17,6 +17,7 @@ ENVS = [
     'parallel_tictactoe',
     'geister',
     'kaggle.hungry_geese',
+    'kaggle.connectx',
 ]
 
 
