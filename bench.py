@@ -589,23 +589,34 @@ def _actor_env() -> str:
     return os.environ.get('BENCH_ACTOR_ENV', 'HungryGeese')
 
 
-def _actor_args(engine: bool, workers: int):
-    """Merged train_args for one bench fleet (the gather subtree's view)."""
+def _actor_args(backend: str, workers: int):
+    """Merged train_args for one bench fleet (the gather subtree's view).
+
+    ``backend`` is the per-host actor backend: 'worker' (per-worker B=1
+    reference), 'engine' (host batched InferenceEngine), or 'device' (the
+    fused on-device rollout fleet — DeviceActorGather)."""
     from handyrl_tpu.config import apply_defaults
     args = apply_defaults({'env_args': {'env': _actor_env()}})['train_args']
     args['env'] = {'env': _actor_env()}
     args['seed'] = 11
     args['eval_rate'] = 0.0
     args['worker'] = {'num_parallel': workers, 'num_gathers': 1,
-                      'base_worker_id': 0}
+                      'base_worker_id': 0, 'backend': backend}
     args['inference'] = dict(args['inference'],
-                             enabled=engine,
+                             enabled=(backend == 'engine'),
                              batch_wait_ms=float(os.environ.get(
                                  'BENCH_ACTOR_WAIT_MS', '2')))
+    if backend == 'device':
+        args['generation'] = dict(
+            args.get('generation') or {}, backend='device',
+            device_actor_envs=int(os.environ.get(
+                'BENCH_ACTOR_DEVICE_ENVS', '16')),
+            device_actor_chunk_steps=int(os.environ.get(
+                'BENCH_ACTOR_DEVICE_CHUNK', '16')))
     return args
 
 
-def _actor_fleet_run(engine: bool, workers: int, total: int, warm: int,
+def _actor_fleet_run(backend: str, workers: int, total: int, warm: int,
                      snapshot: dict, players: list) -> dict:
     """Spawn ONE real gather (+ its worker processes) over a pipe and act as
     its learner: serve 'g' tasks (each stamped with a deterministic
@@ -619,7 +630,7 @@ def _actor_fleet_run(engine: bool, workers: int, total: int, warm: int,
                                         spawn_pipe_workers)
     from handyrl_tpu.worker import gather_loop
 
-    args = _actor_args(engine, workers)
+    args = _actor_args(backend, workers)
     ep = spawn_pipe_workers(1, gather_loop,
                             lambda i, c: (args, c, i))[0]
     served = 0
@@ -668,6 +679,10 @@ def _actor_fleet_run(engine: bool, workers: int, total: int, warm: int,
         'failed': failed,
         'engine_requests': tele.get('engine_requests_total', 0),
         'engine_batches': tele.get('engine_batches_total', 0),
+        'stamped': sum(1 for e in episodes if e.get('record_version')),
+        'device_plies': tele.get('device_actor_plies_total', 0),
+        'device_episodes': tele.get('device_actor_episodes_total', 0),
+        'device_divergence': tele.get('device_actor_divergence_total', 0),
     }
 
 
@@ -699,11 +714,58 @@ def run_actor(probe: dict):
     players = env.players()
 
     import contextlib
+    backend_row = os.environ.get('BENCH_ACTOR_BACKEND', '').strip().lower()
+    if backend_row == 'device':
+        # device-backend row: the fused on-device rollout fleet against
+        # the engine fleet — same harness, seeds, and task stream. Strict
+        # envs (TicTacToe/ConnectX) byte-compare; device-contract envs
+        # carry a record_version stamp instead (never silently divergent).
+        # The device gather uploads a whole task block per burst, so a
+        # steady-state rate needs >= 2 blocks in the timed window with the
+        # full first block (compile + warmup) excluded — arrival spans
+        # inside one burst only measure upload serialization.
+        lanes = int(os.environ.get('BENCH_ACTOR_DEVICE_ENVS', '16'))
+        warm = max(warm, lanes)
+        total = warm + max(total - warm, 2 * lanes)
+        with contextlib.redirect_stdout(sys.stderr):
+            base = _actor_fleet_run('engine', workers, total, warm,
+                                    snapshot, players)
+            dev = _actor_fleet_run('device', workers, total, warm,
+                                   snapshot, players)
+        emit(dev['episodes_per_sec'],
+             (dev['episodes_per_sec'] / base['episodes_per_sec'])
+             if base['episodes_per_sec'] else 0.0,
+             metric=('fleet episodes/sec (%s, device actor backend: fused '
+                     'on-device rollout scan vs the engine-batched host '
+                     'fleet)' % _actor_env()),
+             backend=probe.get('backend', 'unknown'),
+             device=probe.get('device_kind', 'unknown'),
+             workers=workers, episodes=total - warm, warmup=warm,
+             engine_episodes_per_sec=round(base['episodes_per_sec'], 2),
+             requests_per_sec=round(dev['requests_per_sec'], 2),
+             device_actor_envs=int(os.environ.get(
+                 'BENCH_ACTOR_DEVICE_ENVS', '16')),
+             device_plies=dev['device_plies'],
+             device_divergence=dev['device_divergence'],
+             records_identical=(dev['records'] == base['records']
+                                and len(dev['records']) == total),
+             records_stamped=dev['stamped'],
+             failed_episodes=base['failed'] + dev['failed'],
+             vs_baseline_def=('device-backend episodes/sec / engine '
+                              'episodes/sec, identical harness, seeds '
+                              'and task stream'),
+             env=_actor_env(),
+             run_id=telemetry.run_id(),
+             geometry=('headline'
+                       if (total - warm >= 12
+                           and _actor_env() == 'HungryGeese')
+                       else 'dryrun'))
+        return
     with contextlib.redirect_stdout(sys.stderr):
         # child-process startup prints must not break the one-line contract
-        base = _actor_fleet_run(False, workers, total, warm, snapshot,
+        base = _actor_fleet_run('worker', workers, total, warm, snapshot,
                                 players)
-        eng = _actor_fleet_run(True, workers, total, warm, snapshot,
+        eng = _actor_fleet_run('engine', workers, total, warm, snapshot,
                                players)
 
     fill = eng['engine_requests'] / max(1, eng['engine_batches'])
@@ -1071,6 +1133,8 @@ def run_serve(probe: dict):
     warmup = int(os.environ.get('BENCH_SERVE_WARMUP', '4'))
     wait_ms = os.environ.get('BENCH_SERVE_WAIT_MS', '2')
     drain_n = int(os.environ.get('BENCH_SERVE_DRAIN', '3'))
+    engine_backend = os.environ.get(
+        'BENCH_SERVE_ENGINE_BACKEND', 'cpu').strip().lower() or 'cpu'
 
     env = make_env({'env': env_name})
     env.reset()
@@ -1088,6 +1152,7 @@ def run_serve(probe: dict):
             [sys.executable, '-m', 'handyrl_tpu.serving',
              '--env', env_name, '--registry', root, '--port', '0',
              '--line', 'bench', '--wait-ms', str(wait_ms),
+             '--engine-backend', engine_backend,
              '--max-clients', str(n_clients + 4)],
             cwd=os.path.dirname(os.path.abspath(__file__)),
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
@@ -1148,6 +1213,7 @@ def run_serve(probe: dict):
              backend=probe.get('backend', 'unknown'),
              device=probe.get('device_kind', 'unknown'),
              env=env_name, clients=n_clients,
+             engine_backend=engine_backend,
              requests_per_client=requests,
              requests_measured=len(lat_ms),
              single_client_requests_per_sec=round(base_rps, 2),

@@ -243,7 +243,8 @@ def collect_config_keys(config_src: SourceFile
                     keys.extend(_literal_keys(node.value))
         if isinstance(node, ast.FunctionDef) and node.name == 'validate':
             # knob references through the block aliases validate() uses
-            _BLOCKS = {'ta', 'ft', 'inf', 'g', 'tel', 'par', 'srv', 'flt', 'lg'}
+            _BLOCKS = {'ta', 'ft', 'inf', 'g', 'tel', 'par', 'srv', 'flt',
+                       'lg', 'gen'}
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Call) \
                         and isinstance(sub.func, ast.Attribute) \

@@ -185,6 +185,18 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'min_games': 20,         # rated games the learner must book since the last champion flip before promotion is considered
     },
 
+    # fleet generation backend (worker.py gather_loop + DeviceActorGather,
+    # device_generation.py DeviceActorEngine, docs/large_scale_training.md
+    # "Device actor backend"): how a gather host turns its assigned ledger
+    # tasks into episode records
+    'generation': {
+        'backend': '',            # '' = auto (engine when inference.enabled, else worker); 'worker' = per-worker stepping, 'engine' = host-batched inference, 'device' = the fused Anakin scan (envs with a pure-JAX twin); a gather host overrides it with worker_args.backend
+        'device_actor_envs': 64,  # parallel envs inside the device actor's compiled scan — one ledger task per env lane
+        'device_actor_chunk_steps': 16,  # plies per compiled chunk dispatch; the scan fill-ratio gauge watches lanes idled by finished episodes
+        'device_actor_slots': 2,  # stacked opponent-param slots traced into the ONE compiled program (slot 0 = learner params); league pairings beyond this defer to a later block instead of retracing
+        'device_actor_record': '',  # '' = auto per the env twin's RNG_COMPAT contract; 'strict' = replay sampling host-side for byte-compatible records; 'device' = faster device-sampled records, record_version-stamped
+    },
+
     # unified telemetry (docs/observability.md): metric registry + spans +
     # heartbeat-piggybacked fleet aggregation + optional Prometheus endpoint
     # + episode-lifecycle distributed tracing. Accepts a bool (legacy
@@ -208,6 +220,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
 WORKER_DEFAULTS: Dict[str, Any] = {
     'server_address': '',
     'num_parallel': 8,
+    'backend': '',   # per-host generation-backend override ('' = follow generation.backend): a host that owns an accelerator sets 'device' while the rest of the fleet keeps the worker/engine path
 }
 
 
@@ -449,6 +462,23 @@ def validate(args: Dict[str, Any]) -> None:
         assert srv.get('publish'), \
             'league.enabled requires serving.publish (pool members ARE the ' \
             "registry line's versions)"
+    gen = ta.get('generation') or {}
+    _BACKENDS = ('', 'worker', 'engine', 'device')
+    assert str(gen.get('backend', '')) in _BACKENDS, \
+        "generation.backend must be '', 'worker', 'engine' or 'device'"
+    assert int(gen.get('device_actor_envs', 64)) >= 1, \
+        'generation.device_actor_envs must be >= 1'
+    assert int(gen.get('device_actor_chunk_steps', 16)) >= 1, \
+        'generation.device_actor_chunk_steps must be >= 1'
+    assert int(gen.get('device_actor_slots', 2)) >= 1, \
+        'generation.device_actor_slots must be >= 1 (slot 0 carries the ' \
+        'learner params)'
+    assert str(gen.get('device_actor_record', '')) in \
+        ('', 'strict', 'device'), \
+        "generation.device_actor_record must be '', 'strict' or 'device'"
+    assert str((args.get('worker_args') or {}).get('backend', '')) \
+        in _BACKENDS, \
+        "worker_args.backend must be '', 'worker', 'engine' or 'device'"
     par = ta.get('parallel') or {}
     assert int(par.get('model_parallel', 1)) >= 1, \
         'parallel.model_parallel must be >= 1 (1 = no tensor parallelism)'

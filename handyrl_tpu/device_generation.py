@@ -23,12 +23,17 @@ program dispatch regardless of N.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
+from .generation import (Generator, _blank_moment, _finalize_episode,
+                         bucketed_inference, masked_sample, pad_to_bucket,
+                         sample_seed, seed_env_rng)
 from .ops.batch import compress_moments
 from .utils.tree import map_structure
 
@@ -614,3 +619,650 @@ class DeviceEvaluator:
                 'result': {p: float(outcomes[k, i, p]) for p in players},
             })
         return results
+
+
+# ---------------------------------------------------------------------------
+# device actor backend (generation.backend: device): a gather that OWNS an
+# accelerator serves ledger tasks with fused on-device rollouts instead of a
+# worker fleet. One compiled program plays every pairing the learner
+# stamps — self-play, league PFSP opponents, rating matches — by stacking
+# up to ``device_actor_slots`` parameter sets as pytree leaves and
+# selecting each seat's logits by a per-(lane, seat) slot index, so a new
+# opponent mix is a new params UPLOAD, never a retrace.
+
+# per-seat policies inside the compiled ply (device arrays, not python):
+#   SAMPLE  — sample the seat's slot policy (generation 'g' seats)
+#   GREEDY  — argmax the seat's slot policy (evaluation model seats,
+#             reference agent.py Agent at temperature 0)
+#   UNIFORM — uniform over legal actions (mid-0 / 'random' seats; matches
+#             RandomModel + masked_sample over a zero policy)
+#   FIRST   — first legal action (Agent(RandomModel): argmax of zeros-mask)
+#   RULEBASE— the env twin's vectorized ``greedy_action`` heuristic
+MODE_SAMPLE, MODE_GREEDY, MODE_UNIFORM, MODE_FIRST, MODE_RULEBASE = range(5)
+
+
+class Divergence(Exception):
+    """A device-played action disagrees with the host sampling contract
+    (float-boundary collision between the f32 on-device inverse-CDF and the
+    f64 host cumsum); the episode reruns on the host path."""
+
+
+def resolve_record_mode(env_mod, recurrent: bool, requested: str = '') -> str:
+    """Resolve the device-actor record mode for an env twin.
+
+    'strict' — device episodes are verified against the host sampling
+    contract at splice time and uploaded BYTE-IDENTICAL to worker/engine
+    records (divergent lanes rerun on the host); requires the env to be
+    deterministic given the action sequence (``RNG_COMPAT == 'strict'``),
+    turn-based, and the model non-recurrent (a hidden-state chain cannot be
+    recomputed as one batched call). 'device' — episodes are spliced from
+    the on-device trajectory and stamped ``record_version: 1``; never
+    silently divergent. '' auto-selects strict whenever legal."""
+    compat = str(getattr(env_mod, 'RNG_COMPAT', 'device'))
+    simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+    strict_ok = compat == 'strict' and not recurrent and not simultaneous
+    if requested == 'strict':
+        if not strict_ok:
+            raise ValueError(
+                'device_actor_record=strict requires a turn-based env twin '
+                "with RNG_COMPAT == 'strict' and a non-recurrent model "
+                '(got compat=%r, recurrent=%s, simultaneous=%s)'
+                % (compat, recurrent, simultaneous))
+        return 'strict'
+    if requested == 'device':
+        return 'device'
+    return 'strict' if strict_ok else 'device'
+
+
+def _tree_where(cond, a, b):
+    """Per-lane select over a state pytree (cond broadcast to each leaf)."""
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(
+            cond.reshape((-1,) + (1,) * (x.ndim - 1)), x, y), a, b)
+
+
+def _u_pick(weights, legal, u):
+    """Inverse-CDF draw matching generation.masked_sample's searchsorted:
+    the first legal action whose inclusive cumulative weight exceeds
+    ``u * total``; the last legal action when rounding pushes u past the
+    end. Rows whose weights are all zero (frozen lanes) fall through to
+    the last-legal clamp and are discarded by the caller's live mask."""
+    legalb = legal > 0
+    c = jnp.cumsum(weights * legal, axis=-1)
+    total = c[:, -1:]
+    cond = (c > u[:, None] * total) & legalb
+    acts = legal.shape[-1]
+    last_legal = (acts - 1) - jnp.argmax(legalb[:, ::-1], axis=-1)
+    return jnp.where(cond.any(axis=-1), jnp.argmax(cond, axis=-1),
+                     last_legal).astype(jnp.int32)
+
+
+class DeviceActorEngine:
+    """Fused Anakin-style rollout engine behind the gather task loop.
+
+    ``run_block`` takes a list of server-stamped ledger tasks ('g' episode
+    and 'e' evaluation assignments, one lane each), plays them ALL inside
+    chunked invocations of ONE jitted scan — inference for every slot's
+    params, per-seat action modes, transition, termination — and splices
+    the finished lanes into standard upload payloads. Lanes freeze when
+    their episode ends (block-synchronous; no auto-reset), so a task's
+    record is exactly one episode, attributable to its task_id.
+
+    Tasks the program cannot express (unknown opponents, slot overflow
+    beyond the compiled stack, missing sample keys in strict mode) are
+    returned for the caller's host fallback instead of forcing a retrace.
+    """
+
+    def __init__(self, env_mod, vault, host_env, args: Dict[str, Any],
+                 n_envs: int = 64, chunk_steps: int = 16, slots: int = 2,
+                 record_mode: str = '', seed: int = 0):
+        self.args = args
+        self.vault = vault
+        self.host_env = host_env
+        self.n_envs = int(n_envs)
+        self.chunk_steps = int(chunk_steps)
+        self.slots = max(1, int(slots))
+        self.seed = int(seed)
+        self.env_mod = env_mod
+        self.num_players = int(env_mod.NUM_PLAYERS)
+        self.simultaneous = bool(getattr(env_mod, 'SIMULTANEOUS', False))
+        self.max_steps = int(getattr(env_mod, 'MAX_STEPS', 1000))
+        self._has_rule = hasattr(env_mod, 'greedy_action')
+        # recurrence is architecture-structural: the env's registered net
+        # decides it before any snapshot arrives
+        self.recurrent = hasattr(host_env.net(), 'init_hidden')
+        self.record_mode = resolve_record_mode(env_mod, self.recurrent,
+                                               str(record_mode or ''))
+        self.blocks = 0
+        self._built = None          # wrapper the program was traced from
+        self._rollout = None
+        self._pack = None
+        self._stack_key = None
+        self._stacked = None
+        self._gen = None            # lazy host Generator for strict reruns
+        self._m_plies = telemetry.counter('device_actor_plies_total')
+        self._m_episodes = telemetry.counter('device_actor_episodes_total')
+        self._m_results = telemetry.counter('device_actor_results_total')
+        self._m_divergence = telemetry.counter(
+            'device_actor_divergence_total')
+        self._m_chunk = telemetry.REGISTRY.histogram(
+            'device_actor_chunk_seconds')
+        self._m_fill = telemetry.gauge('device_actor_fill_ratio')
+        telemetry.install_jax_monitoring()
+
+    # -- task classification ----------------------------------------------
+
+    def _classify(self, task) -> Dict[str, Any]:
+        """Map one ledger task onto per-seat (mode, slot-mid) vectors, or
+        None when the compiled program cannot express it (host fallback)."""
+        role = (task or {}).get('role')
+        P = self.num_players
+        raw = (task or {}).get('model_id') or {}
+        mids = {p: int(raw.get(p, -1)) for p in range(P)}
+        modes = [MODE_FIRST] * P
+        slot_mids = []
+        if role == 'g':
+            if self.record_mode == 'strict' \
+                    and task.get('sample_key') is None:
+                return None     # no server key => no byte contract to keep
+            for p in range(P):
+                if mids[p] >= 1:
+                    modes[p] = MODE_SAMPLE
+                    slot_mids.append(mids[p])
+                elif mids[p] == 0:
+                    modes[p] = MODE_UNIFORM
+                else:
+                    return None
+            return {'task': task, 'kind': 'episode', 'modes': modes,
+                    'mids': mids, 'slot_mids': slot_mids, 'opponent': None}
+        if role == 'e':
+            seat = int(task['player'][0])
+            opponent = task.get('opponent')
+            if not opponent:
+                opponents = (self.args.get('eval') or {}).get('opponent', [])
+                skey = task.get('sample_key')
+                if opponents and skey is not None:
+                    # the Evaluator's namespace-2 pool draw, replicated so
+                    # the opponent identity matches a host re-issue exactly
+                    seq = sample_seed(self.args.get('seed', 0),
+                                      (2, int(skey)), 0)
+                    opponent = opponents[int(
+                        np.random.default_rng(seq).integers(len(opponents)))]
+                elif opponents:
+                    return None   # unkeyed pool draw: host decides
+                else:
+                    opponent = 'random'
+            for p in range(P):
+                if p == seat:
+                    modes[p] = MODE_GREEDY if mids[p] >= 1 else MODE_FIRST
+                    if mids[p] >= 1:
+                        slot_mids.append(mids[p])
+                elif mids[p] >= 1:
+                    modes[p] = MODE_GREEDY
+                    slot_mids.append(mids[p])
+                elif opponent == 'random':
+                    modes[p] = MODE_UNIFORM
+                elif str(opponent).startswith('rulebase') and self._has_rule:
+                    modes[p] = MODE_RULEBASE
+                else:
+                    return None   # checkpoint/serving opponents: host path
+            return {'task': task, 'kind': 'result', 'modes': modes,
+                    'mids': mids, 'slot_mids': slot_mids,
+                    'opponent': opponent}
+        return None
+
+    # -- compiled program ---------------------------------------------------
+
+    def _build(self, wrapper):
+        """Trace the one chunk program from the first materialized wrapper.
+        Everything that varies per block — the stacked params, the per-seat
+        slot/mode tables, the precomputed sampling draws, liveness — is a
+        program INPUT of fixed shape, so league pairings and model updates
+        never retrace."""
+        assert hasattr(wrapper.module, 'init_hidden') == self.recurrent, \
+            'env net() and snapshot disagree on recurrence'
+        env_mod, M = self.env_mod, self.slots
+        N, P = self.n_envs, self.num_players
+        simultaneous, recurrent = self.simultaneous, self.recurrent
+        strict = self.record_mode == 'strict'
+        full = self.record_mode == 'device'
+        has_rule, has_rew = self._has_rule, hasattr(env_mod, 'rewards')
+        apply_fn = wrapper.module.apply
+
+        def chunk(stacked, state, hidden, u_tab, seat_slot, seat_mode,
+                  live, t, rng):
+            def body(carry, _):
+                state, hidden, live, t, rng = carry
+                rows = jnp.arange(N)
+                per_slot = []
+                for m in range(M):
+                    pm = jax.tree_util.tree_map(lambda x: x[m], stacked)
+                    per_slot.append(_ply_inference(
+                        env_mod, apply_fn, recurrent, simultaneous,
+                        pm, state, hidden))
+                obs, amask = per_slot[0][0], per_slot[0][2]
+                legal = (amask <= 0).astype(jnp.float32)
+                logitsM = jnp.stack([s[1] for s in per_slot])
+                valM = None
+                if per_slot[0][4].get('value') is not None:
+                    valM = jnp.stack(
+                        [s[4]['value'].reshape((N, P, -1))
+                         if simultaneous else s[4]['value']
+                         for s in per_slot])
+                rng, k1, k2, k3 = jax.random.split(rng, 4)
+                if simultaneous:
+                    cols = jnp.arange(P)[None, :]
+                    rows2 = rows[:, None]
+                    logits = logitsM[seat_slot, rows2, cols]   # (N, P, A)
+                    value = (valM[seat_slot, rows2, cols]
+                             if valM is not None else None)
+                    mode = seat_mode
+                    a_sample = jax.random.categorical(k1, logits)
+                    a_unif = jax.random.categorical(k2, -amask)
+                else:
+                    player = env_mod.turn(state)               # (N,)
+                    slot_act = seat_slot[rows, player]
+                    logits = logitsM[slot_act, rows]           # (N, A)
+                    value = (valM[slot_act, rows]
+                             if valM is not None else None)
+                    mode = seat_mode[rows, player]
+                    if strict:
+                        idx = jnp.minimum(t, u_tab.shape[1] - 1)
+                        u = u_tab[rows, idx]
+                        probs_u = jax.nn.softmax(logits, axis=-1)
+                        a_sample = _u_pick(probs_u, legal, u)
+                        a_unif = _u_pick(jnp.ones_like(legal), legal, u)
+                    else:
+                        a_sample = jax.random.categorical(k1, logits)
+                        a_unif = jax.random.categorical(k2, -amask)
+                    if recurrent:
+                        hidden = jax.tree_util.tree_map(
+                            lambda *hs: jnp.stack(hs)[slot_act, rows],
+                            *[s[3] for s in per_slot])
+                if simultaneous and recurrent:
+                    cols = jnp.arange(P)[None, :]
+                    rows2 = rows[:, None]
+                    hidden = jax.tree_util.tree_map(
+                        lambda *hs: jnp.stack(hs)[seat_slot, rows2, cols],
+                        *[s[3] for s in per_slot])
+                probs = jax.nn.softmax(logits, axis=-1)
+                a_greedy = jnp.argmax(logits, axis=-1)
+                a_first = jnp.argmax(legal, axis=-1)
+                action = a_first
+                action = jnp.where(mode == MODE_SAMPLE, a_sample, action)
+                action = jnp.where(mode == MODE_GREEDY, a_greedy, action)
+                action = jnp.where(mode == MODE_UNIFORM, a_unif, action)
+                if has_rule:
+                    a_rule = env_mod.greedy_action(state, k3)
+                    action = jnp.where(mode == MODE_RULEBASE, a_rule, action)
+                action = action.astype(jnp.int32)
+                sel = jnp.take_along_axis(probs, action[..., None],
+                                          axis=-1)[..., 0]
+                gate = live[:, None] if simultaneous else live
+                action = jnp.where(gate, action, 0)
+                nstate = env_mod.step(state, action)
+                nstate = _tree_where(live, nstate, state)     # freeze done
+                done_now = env_mod.terminal(nstate) & live
+                record = {'action': action, 'live': live, 'done': done_now,
+                          'outcome': env_mod.outcome(nstate)}
+                if simultaneous:
+                    record['acting'] = env_mod.acting(state)
+                else:
+                    record['player'] = env_mod.turn(state)
+                if full:
+                    record['obs'] = obs
+                    record['prob'] = sel
+                    record['amask'] = amask
+                    if value is not None:
+                        record['value'] = value
+                    if has_rew:
+                        record['reward'] = env_mod.rewards(nstate)
+                t = t + live.astype(jnp.int32)
+                live = live & ~done_now
+                return (nstate, hidden, live, t, rng), record
+
+            (state, hidden, live, t, rng), records = jax.lax.scan(
+                body, (state, hidden, live, t, rng), None,
+                length=self.chunk_steps)
+            return state, hidden, live, t, rng, dict(records)
+
+        self._rollout = jax.jit(chunk)
+        self._built = wrapper
+
+    def _stack_params(self, assign: Dict[int, int]):
+        """Stack each slot's params as pytree leaves (unused slots padded
+        with the first real params so the tree is dense). Cached on the
+        slot->mid map: re-serving the same pairing costs nothing."""
+        by_slot = [None] * self.slots
+        for mid, slot in assign.items():
+            by_slot[slot] = mid
+        key = tuple(by_slot)
+        if key == self._stack_key:
+            return self._stacked
+        pad = self.vault.params(next(iter(assign)))
+        trees = [self.vault.params(mid) if mid is not None else pad
+                 for mid in by_slot]
+        self._stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *trees)
+        self._stack_key = key
+        return self._stacked
+
+    # -- block execution ----------------------------------------------------
+
+    def run_block(self, tasks):
+        """Serve one block of ledger tasks on device.
+
+        Returns ``(uploads, deferred)``: uploads are ``(kind, payload)``
+        pairs ready for the gather's upload box (payload None for a lane
+        that failed — the ledger's deadline re-issues it); deferred tasks
+        need the host fallback path."""
+        deferred, plan = [], []
+        for task in tasks:
+            if task.get('role') == 'idle':
+                continue
+            cls = self._classify(task)
+            (plan if cls is not None else deferred).append(cls or task)
+        if len(plan) > self.n_envs:
+            # more tasks than lanes: overflow rides the host fallback
+            deferred.extend(cls['task'] for cls in plan[self.n_envs:])
+            plan = plan[:self.n_envs]
+        if not plan:
+            return [], deferred
+
+        # slot planning: league.plan_slots admits tasks in order until the
+        # compiled stack is full; overflow rides the host fallback
+        from .league import plan_slots
+        assign, admitted = plan_slots(
+            [cls['slot_mids'] for cls in plan], self.slots)
+        kept = []
+        for cls, ok in zip(plan, admitted):
+            (kept if ok else deferred).append(cls if ok else cls['task'])
+        plan = kept
+        if not assign or not plan:
+            # nothing slot-backed to run (epoch 0, or pure overflow):
+            # the program needs at least one real params tree
+            deferred.extend(cls['task'] for cls in plan)
+            return [], deferred
+
+        if self._rollout is None:
+            self._build(self.vault.model(next(iter(assign))))
+        stacked = self._stack_params(assign)
+
+        N, P = self.n_envs, self.num_players
+        strict = self.record_mode == 'strict'
+        seat_slot = np.zeros((N, P), np.int32)
+        seat_mode = np.full((N, P), MODE_FIRST, np.int32)
+        live = np.zeros((N,), bool)
+        u_len = self.max_steps if strict else 1
+        u_tab = np.zeros((N, u_len), np.float32)
+        base_seed = self.args.get('seed', 0)
+        for i, cls in enumerate(plan):
+            live[i] = True
+            for p in range(P):
+                seat_mode[i, p] = cls['modes'][p]
+                mid = cls['mids'][p]
+                if cls['modes'][p] in (MODE_SAMPLE, MODE_GREEDY):
+                    seat_slot[i, p] = assign[mid]
+            if strict:
+                skey = cls['task'].get('sample_key')
+                if cls['kind'] == 'episode':
+                    ekey, d0 = (0, int(skey)), 0
+                else:
+                    # eval lanes carry no byte contract; draw 0 named the
+                    # opponent, so per-ply draws continue the same stream
+                    ekey, d0 = (2, int(skey if skey is not None else i)), 1
+                for tt in range(u_len):
+                    seq = sample_seed(base_seed, ekey, d0 + tt)
+                    u_tab[i, tt] = np.random.default_rng(seq).random()
+
+        block_seed = self.seed + 7919 * self.blocks
+        self.blocks += 1
+        try:
+            state = self.env_mod.init_state(N, block_seed)
+        except TypeError:
+            state = self.env_mod.init_state(N)
+        hidden = (self._built.module.init_hidden((N, P))
+                  if self.recurrent else None)
+        live_d = jnp.asarray(live)
+        t_d = jnp.zeros((N,), jnp.int32)
+        rng = jax.random.PRNGKey(block_seed)
+        u_d = jnp.asarray(u_tab)
+        slot_d = jnp.asarray(seat_slot)
+        mode_d = jnp.asarray(seat_mode)
+
+        chunks, plies_run = [], 0
+        n_chunks_cap = max(2, -(-self.max_steps // self.chunk_steps) + 2)
+        for _ in range(n_chunks_cap):
+            t0 = time.perf_counter()
+            state, hidden, live_d, t_d, rng, records = self._rollout(
+                stacked, state, hidden, u_d, slot_d, mode_d,
+                live_d, t_d, rng)
+            if self._pack is None:
+                self._pack = _RecordPacker(records)
+            rec = self._pack.unpack(self._pack.pack(records))
+            self._m_chunk.observe(time.perf_counter() - t0)
+            chunks.append(rec)
+            plies_run += int(rec['live'].sum())
+            if not (rec['live'][-1] & ~rec['done'][-1]).any():
+                break
+        self._m_plies.inc(plies_run)
+        scheduled = len(chunks) * self.chunk_steps * max(1, len(plan))
+        self._m_fill.set(plies_run / max(1, scheduled))
+        # observations can be dict pytrees (e.g. Geister) — concat per leaf
+        rec = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *chunks)
+
+        uploads = []
+        for i, cls in enumerate(plan):
+            ks = np.nonzero(rec['live'][:, i])[0]
+            finished = len(ks) > 0 and bool(rec['done'][ks[-1], i])
+            payload = None
+            if finished:
+                try:
+                    if cls['kind'] == 'result':
+                        payload = self._result_record(cls, i, rec, ks)
+                    elif strict:
+                        payload = self._splice_strict(cls, i, rec, ks)
+                    else:
+                        payload = self._splice_device(cls, i, rec, ks)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                    payload = None
+            uploads.append((cls['kind'], payload))
+        if self.blocks == 1:
+            telemetry.mark_steady_state(note='device actor warmup complete')
+        return uploads, deferred
+
+    # -- splicing -----------------------------------------------------------
+
+    def _result_record(self, cls, lane, rec, ks):
+        """Evaluation lanes upload outcome-only records (the Evaluator's
+        ``{'args', 'opponent', 'result'}`` contract)."""
+        k = ks[-1]
+        players = list(range(self.num_players))
+        self._m_results.inc()
+        return {'args': cls['task'], 'opponent': cls['opponent'],
+                'result': {p: float(rec['outcome'][k, lane, p])
+                           for p in players}}
+
+    def _splice_device(self, cls, lane, rec, ks):
+        """Assemble a ``record_version: 1`` episode from the on-device
+        trajectory (the DeviceGenerator moment layout, one lane)."""
+        task = cls['task']
+        players = list(range(self.num_players))
+        moments = []
+        for k in ks:
+            if self.simultaneous:
+                moments.append(self._lane_moment_simultaneous(
+                    rec, k, lane, players))
+            else:
+                moments.append(self._lane_moment_turn_based(
+                    rec, k, lane, players))
+        k = ks[-1]
+        outcome = {p: float(rec['outcome'][k, lane, p]) for p in players}
+        for p in players:
+            ret = 0.0
+            for t in range(len(moments) - 1, -1, -1):
+                ret = ((moments[t]['reward'][p] or 0)
+                       + self.args['gamma'] * ret)
+                moments[t]['return'][p] = ret
+        telemetry.counter('episodes_generated_total').inc()
+        telemetry.counter('generation_steps_total').inc(len(moments))
+        self._m_episodes.inc()
+        return {
+            'args': task, 'steps': len(moments), 'outcome': outcome,
+            'moment': compress_moments(
+                moments, self.args['compress_steps'],
+                level=self.args.get('compress_level', 9)),
+            # records from this path follow the device rng contract, not
+            # the host byte contract: stamped, never silently divergent
+            'record_version': 1,
+        }
+
+    def _lane_moment_turn_based(self, rec, k, i, players):
+        player = int(rec['player'][k, i])
+        moment = _blank(players)
+        moment['observation'][player] = map_structure(
+            lambda v: v[k, i], rec['obs'])
+        moment['selected_prob'][player] = float(rec['prob'][k, i])
+        moment['action_mask'][player] = rec['amask'][k, i]
+        moment['action'][player] = int(rec['action'][k, i])
+        if rec.get('value') is not None:
+            moment['value'][player] = rec['value'][k, i]
+        moment['reward'] = self._lane_rewards(rec, k, i, players)
+        moment['turn'] = [player]
+        return moment
+
+    def _lane_moment_simultaneous(self, rec, k, i, players):
+        moment = _blank(players)
+        turn_players = []
+        for p in players:
+            if not rec['acting'][k, i, p]:
+                continue
+            turn_players.append(p)
+            moment['observation'][p] = map_structure(
+                lambda v: v[k, i, p], rec['obs'])
+            moment['selected_prob'][p] = float(rec['prob'][k, i, p])
+            moment['action_mask'][p] = rec['amask'][k, i, p]
+            moment['action'][p] = int(rec['action'][k, i, p])
+            if rec.get('value') is not None:
+                moment['value'][p] = rec['value'][k, i, p]
+        moment['reward'] = self._lane_rewards(rec, k, i, players)
+        moment['turn'] = turn_players
+        return moment
+
+    def _lane_rewards(self, rec, k, i, players):
+        if rec.get('reward') is None:
+            return {p: None for p in players}
+        return {p: float(rec['reward'][k, i, p]) for p in players}
+
+    def _splice_strict(self, cls, lane, rec, ks):
+        """Replay the lane's device actions through the HOST env + sampling
+        contract and verify every draw. A verified lane's moments are, by
+        construction, the ones the host Generator would have produced —
+        the record is byte-identical and carries no version stamp. Any
+        mismatch (f32/f64 cumsum boundary collision) falls back to a full
+        host Generator rerun: correctness is unconditional, the device
+        speedup is probabilistic."""
+        task = cls['task']
+        try:
+            episode = self._replay_strict(task, lane, rec, ks)
+        except Divergence:
+            episode = None
+        if episode is None:
+            self._m_divergence.inc()
+            episode = self._host_rerun(task)
+        else:
+            self._m_episodes.inc()
+        return episode
+
+    def _replay_strict(self, task, lane, rec, ks):
+        env = self.host_env
+        args = self.args
+        base_seed = args.get('seed', 0)
+        episode_key = (0, int(task['sample_key']))
+        seed_env_rng(env, base_seed, episode_key)
+        if env.reset():
+            raise Divergence
+        device_actions = [int(a) for a in rec['action'][ks, lane]]
+        plies = []      # [player, obs, legal, seed_seq, reward, action]
+        draws = 0
+        for a_dev in device_actions:
+            if env.terminal():
+                raise Divergence             # device episode ran long
+            turn_players = env.turns()
+            if len(turn_players) != 1:
+                raise Divergence             # strict is turn-based only
+            p = turn_players[0]
+            obs = env.observation(p)
+            seed_seq = sample_seed(base_seed, episode_key, draws)
+            draws += 1
+            legal = env.legal_actions(p)
+            if a_dev not in legal:
+                raise Divergence
+            if env.step({p: a_dev}):
+                raise Divergence
+            plies.append([p, obs, legal, seed_seq, env.reward(), a_dev])
+        if not env.terminal():
+            raise Divergence                 # device episode ended early
+
+        # batched recompute per distinct model, chunked to the SAME bucket
+        # the Generator's per-ply bucketed_inference dispatches (bucket 8):
+        # rows within one bucket are row-independent, but the same row CAN
+        # stray across bucket SIZES on some device meshes, so byte parity
+        # requires never escalating to a larger bucket here
+        models = self.vault.obtain(dict(task['model_id']))
+        outputs = [None] * len(plies)
+        groups: Dict[int, list] = {}
+        for j, ply in enumerate(plies):
+            groups.setdefault(id(models[ply[0]]), []).append(j)
+        with telemetry.expected_compile('device-actor strict recompute'):
+            for idxs in groups.values():
+                model = models[plies[idxs[0]][0]]
+                if not hasattr(model, 'batch_inference'):
+                    for j in idxs:           # RandomModel: zero outputs
+                        outputs[j] = bucketed_inference(model, plies[j][1])
+                    continue
+                for lo in range(0, len(idxs), 8):
+                    chunk = idxs[lo:lo + 8]
+                    obs_b, _ = pad_to_bucket(
+                        [plies[j][1] for j in chunk])
+                    out = model.batch_inference(obs_b, None)
+                    policy = np.asarray(out['policy'])
+                    value = (np.asarray(out['value'])
+                             if out.get('value') is not None else None)
+                    for row, j in enumerate(chunk):
+                        outputs[j] = {
+                            'policy': policy[row],
+                            'value': (value[row]
+                                      if value is not None else None)}
+
+        moments = []
+        for j, (p, obs, legal, seed_seq, reward, a_dev) in enumerate(plies):
+            action, prob, mask = masked_sample(
+                outputs[j]['policy'], legal, seed_seq)
+            if action != a_dev:
+                raise Divergence             # boundary collision: rerun
+            moment = _blank_moment(env.players())
+            moment['observation'][p] = obs
+            moment['value'][p] = outputs[j].get('value')
+            moment['selected_prob'][p] = prob
+            moment['action_mask'][p] = mask
+            moment['action'][p] = action
+            for player in env.players():
+                moment['reward'][player] = reward.get(player, None)
+            moment['turn'] = [p]
+            moments.append(moment)
+        return _finalize_episode(env, moments, args, task)
+
+    def _host_rerun(self, task):
+        """Byte-exact fallback: the standard host Generator replays the
+        task from its server-stamped key (same record any worker would
+        upload)."""
+        if self._gen is None:
+            self._gen = Generator(self.host_env, self.args,
+                                  namespace=-1)
+        models = self.vault.obtain(dict(task['model_id']))
+        with telemetry.expected_compile('device-actor host rerun'):
+            return self._gen.execute(models, task)

@@ -32,6 +32,7 @@ JAX_ENVS = {
     'TicTacToe': 'handyrl_tpu.envs.jax_tictactoe',
     'HungryGeese': 'handyrl_tpu.envs.jax_hungry_geese',
     'Geister': 'handyrl_tpu.envs.jax_geister',
+    'ConnectX': 'handyrl_tpu.envs.jax_connectx',
 }
 
 

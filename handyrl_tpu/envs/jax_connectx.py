@@ -1,0 +1,155 @@
+"""Pure-JAX vectorized ConnectX: kaggle's Connect Four as jittable array
+functions.
+
+The host env (envs/kaggle/connectx.py) implements the default kaggle
+configuration (rows=6, columns=7, inarow=4) in Python; this module is its
+fully device-resident twin for the fused rollout engines
+(device_generation.py): N boards advance as one program — the drop-to-
+lowest-empty transition, win detection over the precomputed 4-cell lines,
+the TicTacToe-style observation codec and auto-reset are all jnp ops.
+
+State pytree (all leaves have leading env axis N):
+  boards  (N, 42) int8   +1 first player / -1 second / 0 empty (row-major)
+  side    (N,)    int8   side to move (+1/-1)
+  winner  (N,)    int8   +1/-1 when decided, 0 otherwise
+  moves   (N,)    int8   plies played (<= 42)
+
+``greedy_action`` vectorizes the host ``rule_based_action`` heuristic
+exactly (win now, else block, else center-out) so 'rulebase' league seats
+run inside the compiled ply.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kaggle.connectx import CENTER_ORDER, COLS, IN_A_ROW, ROWS, WIN_LINES
+
+N_ACTIONS = COLS
+MAX_STEPS = ROWS * COLS
+NUM_PLAYERS = 2
+# the env is deterministic given the action sequence, so device records can
+# replay byte-identically through the host sampling contract
+RNG_COMPAT = 'strict'
+
+# CENTER_RANK[c] = preference rank of column c in the heuristic's
+# center-out ordering (lower = preferred)
+CENTER_RANK = np.empty(COLS, dtype=np.int32)
+for _rank, _col in enumerate(CENTER_ORDER):
+    CENTER_RANK[_col] = _rank
+
+
+class State(NamedTuple):
+    boards: jnp.ndarray
+    side: jnp.ndarray
+    winner: jnp.ndarray
+    moves: jnp.ndarray
+
+
+def init_state(n: int) -> State:
+    return State(
+        boards=jnp.zeros((n, ROWS * COLS), jnp.int8),
+        side=jnp.ones((n,), jnp.int8),
+        winner=jnp.zeros((n,), jnp.int8),
+        moves=jnp.zeros((n,), jnp.int8),
+    )
+
+
+def legal_mask(state: State) -> jnp.ndarray:
+    """(N, 7) float 1 = legal: the column's top cell is empty."""
+    top = state.boards.reshape(-1, ROWS, COLS)[:, 0, :]
+    return (top == 0).astype(jnp.float32)
+
+
+def terminal(state: State) -> jnp.ndarray:
+    return (state.winner != 0) | (state.moves >= MAX_STEPS)
+
+
+def turn(state: State) -> jnp.ndarray:
+    """Acting player index (0/1) per env."""
+    return (state.moves % 2).astype(jnp.int32)
+
+
+def observe(state: State) -> jnp.ndarray:
+    """Side-to-move view planes (N, 3, 6, 7): [const 1, mine, theirs] —
+    the host env's observation codec (connectx.py observation)."""
+    board = state.boards.reshape(-1, ROWS, COLS)
+    mine = (board == state.side[:, None, None]).astype(jnp.float32)
+    theirs = (board == -state.side[:, None, None]).astype(jnp.float32)
+    ones = jnp.ones_like(mine)
+    return jnp.stack([ones, mine, theirs], axis=1)
+
+
+def _drop_index(boards: jnp.ndarray, cols: jnp.ndarray):
+    """Flat cell index of a drop into ``cols`` per env, plus validity.
+
+    Returns (idx (N,), ok (N,)): ``ok`` is False for a full column (the
+    index is then clamped into range; callers mask with legality)."""
+    n = boards.shape[0]
+    board = boards.reshape(n, ROWS, COLS)
+    filled = (board[jnp.arange(n), :, cols] != 0).sum(axis=1)
+    row = ROWS - 1 - filled
+    idx = jnp.clip(row, 0, ROWS - 1) * COLS + cols
+    return idx, row >= 0
+
+
+def step(state: State, actions: jnp.ndarray) -> State:
+    """Drop one checker per env (callers only feed legal actions; envs
+    already terminal are replaced by auto-reset)."""
+    n = state.boards.shape[0]
+    idx, _ = _drop_index(state.boards, actions)
+    boards = state.boards.at[jnp.arange(n), idx].set(state.side)
+    line_sums = boards[:, WIN_LINES].sum(axis=2)
+    won = (line_sums
+           == IN_A_ROW * state.side[:, None].astype(jnp.int32)).any(axis=1)
+    winner = jnp.where(won & (state.winner == 0), state.side, state.winner)
+    return State(boards=boards, side=-state.side,
+                 winner=winner.astype(jnp.int8),
+                 moves=state.moves + 1)
+
+
+def outcome(state: State) -> jnp.ndarray:
+    """(N, 2) outcome per player seat (player 0 moves first)."""
+    w = state.winner.astype(jnp.float32)
+    return jnp.stack([w, -w], axis=1)
+
+
+def auto_reset(state: State, done: jnp.ndarray) -> State:
+    """Replace finished envs with fresh boards."""
+    fresh = init_state(state.boards.shape[0])
+    pick = lambda a, b: jnp.where(done.reshape((-1,) + (1,) * (a.ndim - 1)), a, b)
+    return State(*(pick(f, s) for f, s in zip(fresh, state)))
+
+
+def _drop_wins(boards: jnp.ndarray, side: jnp.ndarray, col: int):
+    """Would dropping ``side``'s checker into static column ``col`` make
+    four in a row? (N,) bool, False where the column is full."""
+    n = boards.shape[0]
+    idx, ok = _drop_index(boards, jnp.full((n,), col, jnp.int32))
+    cand = boards.at[jnp.arange(n), idx].set(side)
+    sums = cand[:, WIN_LINES].sum(axis=2)
+    won = (sums == IN_A_ROW * side[:, None].astype(jnp.int32)).any(axis=1)
+    return won & ok
+
+
+def greedy_action(state: State, key=None) -> jnp.ndarray:
+    """Vectorized host ``rule_based_action``: the winning drop if one
+    exists (lowest column first, like the host's ascending legal scan),
+    else the drop blocking the opponent's win, else the first legal column
+    center-out. Deterministic — ``key`` is accepted for the device-eval
+    rulebase protocol and ignored."""
+    legal = legal_mask(state) > 0                                 # (N, 7)
+    my_win = jnp.stack([_drop_wins(state.boards, state.side, c)
+                        for c in range(COLS)], axis=1) & legal
+    opp_win = jnp.stack([_drop_wins(state.boards, -state.side, c)
+                         for c in range(COLS)], axis=1) & legal
+    first = lambda m: jnp.argmax(m, axis=1).astype(jnp.int32)
+    rank = jnp.where(legal, jnp.asarray(CENTER_RANK)[None, :], COLS + 1)
+    center = jnp.argmin(rank, axis=1).astype(jnp.int32)
+    pick = jnp.where(my_win.any(axis=1), first(my_win),
+                     jnp.where(opp_win.any(axis=1), first(opp_win), center))
+    return pick

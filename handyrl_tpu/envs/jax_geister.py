@@ -29,6 +29,9 @@ N_SET = 70
 N_ACTIONS = N_MOVE + N_SET  # 214
 MAX_PLIES = 200
 SIMULTANEOUS = False
+# the host env hides piece colors behind its own rng (secret setup); device
+# records cannot replay through the host sampling contract byte-identically
+RNG_COMPAT = 'device'
 
 BLUE, RED = 0, 1
 

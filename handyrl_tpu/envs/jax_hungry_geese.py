@@ -30,6 +30,9 @@ MAX_STEPS = 200
 N_FOOD = 2
 MAX_LEN_SCORE = N_CELLS + 1
 SIMULTANEOUS = True
+# food spawns draw from the env's own device rng; host replay cannot
+# reproduce them, so device-actor records are record_version-stamped
+RNG_COMPAT = 'device'
 
 # NORTH, SOUTH, WEST, EAST — row/col deltas and the opposite-action table
 DROW = jnp.array([-1, 1, 0, 0], jnp.int32)

@@ -26,6 +26,9 @@ from .tictactoe import WIN_LINES
 N_ACTIONS = 9
 MAX_STEPS = 9
 NUM_PLAYERS = 2
+# deterministic given the action sequence: device-actor records can replay
+# byte-identically through the host sampling contract (generation.py)
+RNG_COMPAT = 'strict'
 
 
 class State(NamedTuple):

@@ -138,6 +138,20 @@ def model_act(model, obs, hidden, legal_actions, seed_seq) -> Dict[str, Any]:
             'value': outputs.get('value'), 'hidden': outputs.get('hidden')}
 
 
+def seed_env_rng(env, base_seed, episode_key) -> None:
+    """Reseed an env's per-instance rng from the episode key.
+
+    Envs with stochastic transitions (e.g. HungryGeese spawns) keep a
+    ``random.Random`` instance; seeding it from (seed, episode_key) makes
+    the whole episode a pure function of (seed, sample_key, params) —
+    replayable on any worker, the host inference engine, or the device
+    actor backend's strict-splice verifier. ONE definition of the seed
+    string, shared by every replay path."""
+    env_rng = getattr(env, 'rng', None)
+    if isinstance(env_rng, random.Random):
+        env_rng.seed('episode:%d:%s' % (int(base_seed), (episode_key,)))
+
+
 def pad_to_bucket(structures: list, min_bucket: int = 8):
     """Stack a list of pytrees row-wise and pad the row count to a
     power-of-two bucket (replicating row 0), so simultaneous games with
@@ -222,9 +236,7 @@ class Generator:
         # HungryGeese spawns); reseeding it from the episode key makes the
         # whole episode a pure function of (seed, sample_key, params) —
         # replayable on any worker and on either inference path
-        env_rng = getattr(self.env, 'rng', None)
-        if isinstance(env_rng, random.Random):
-            env_rng.seed('episode:%d:%s' % (base_seed, (episode_key,)))
+        seed_env_rng(self.env, base_seed, episode_key)
         moments: List[dict] = []
         hidden = {p: models[p].init_hidden() for p in self.env.players()}
         if self.env.reset():

@@ -135,6 +135,12 @@ class ModelVault:
         self._slots.move_to_end(mid)
         return self._slots[mid]
 
+    def params(self, mid: int):
+        """The raw params pytree for one id, or None for ids served as
+        RandomModel (id 0) — the device actor backend stacks these as slot
+        leaves; paramless seats run zero-policy modes instead."""
+        return getattr(self.model(int(mid)), 'params', None)
+
     def _admit(self, mid: int):
         snap = self._fetch(mid)
         self.fetches += 1

@@ -86,6 +86,31 @@ def pfsp_weights(win_rates: Sequence[float], curve: str = 'variance',
     return w + _WEIGHT_FLOOR
 
 
+def plan_slots(task_mids: Sequence[Sequence[int]], slots: int
+               ) -> Tuple[Dict[int, int], List[bool]]:
+    """Pack a block of tasks' model ids into a fixed device slot stack.
+
+    ``task_mids[i]`` lists the model ids task ``i`` needs materialized on
+    device (its slot-backed seats). Tasks are admitted greedily IN ORDER
+    while their ids still fit into ``slots`` distinct entries; a task whose
+    new ids would overflow the compiled stack is skipped (False) — it runs
+    on the host fallback instead of forcing a retrace. Returns
+    ``(assign, admitted)``: the mid -> slot map and the per-task verdicts.
+    The slot count is a compile-time constant of the device actor program,
+    so this plan is the ONLY degree of freedom per block."""
+    assign: Dict[int, int] = {}
+    admitted: List[bool] = []
+    for mids in task_mids:
+        new = sorted({int(m) for m in mids if int(m) >= 1} - set(assign))
+        if len(assign) + len(new) > int(slots):
+            admitted.append(False)
+            continue
+        for m in new:
+            assign[m] = len(assign)
+        admitted.append(True)
+    return assign, admitted
+
+
 def member_name(line: str, version: Any) -> str:
     return '%s@%s' % (line, version)
 

@@ -1,0 +1,33 @@
+"""ConnectX (Connect Four) policy/value net.
+
+Same family as the TicTacToe SimpleConv2dModel — stem conv + normalized
+conv blocks over the (3, 6, 7) plane codec, a 7-way column policy head,
+tanh value head. The 6x7 board carries longer tactical lines than 3x3, so
+the trunk is one block deeper by default.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from . import register
+from .blocks import ConvBlock, PolicyHead, ScalarHead, to_nhwc
+
+
+@register('ConnectFourNet')
+class ConnectFourNet(nn.Module):
+    filters: int = 32
+    layers: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, hidden=None):
+        x = to_nhwc(obs)
+        h = nn.relu(nn.Conv(self.filters, (3, 3), padding='SAME',
+                            dtype=self.dtype)(x))
+        for _ in range(self.layers):
+            h = nn.relu(ConvBlock(self.filters, dtype=self.dtype)(h))
+        policy = PolicyHead(2, 7, dtype=self.dtype)(h)
+        value = jnp.tanh(ScalarHead(1, 1, dtype=self.dtype)(h))
+        return {'policy': policy, 'value': value}

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                     help='override inference.batch_wait_ms')
     ap.add_argument('--max-batch', type=int, default=None,
                     help='override inference.max_batch')
+    ap.add_argument('--engine-backend', default=None,
+                    choices=('cpu', 'device'),
+                    help='override inference.engine_backend (device lets '
+                         'the engines claim a host-local accelerator)')
     # fleet membership (replica mode): register + heartbeat against a
     # resolver; a resolver-directed drain exits 75 like a SIGTERM drain
     ap.add_argument('--resolver', default='',
@@ -73,6 +77,8 @@ def main(argv=None) -> int:
         inference['batch_wait_ms'] = float(args.wait_ms)
     if args.max_batch is not None:
         inference['max_batch'] = int(args.max_batch)
+    if args.engine_backend is not None:
+        inference['engine_backend'] = args.engine_backend
     fleet = {}
     if args.resolver:
         fleet['resolver'] = args.resolver

@@ -1669,6 +1669,12 @@ class Learner:
         for episode in episodes:
             if episode is None:
                 continue
+            if episode.get('record_version'):
+                # device-actor records that follow the device rng contract
+                # instead of the host byte contract arrive stamped; the
+                # counter keeps the divergence observable fleet-wide
+                telemetry.counter(
+                    'device_actor_stamped_episodes_total').inc()
             for p in episode['args']['player']:
                 # attribute stats to the model that actually generated the
                 # episode (the reference books everything under the current

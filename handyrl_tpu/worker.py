@@ -519,10 +519,13 @@ class Gather:
             else:
                 self.hub.send(ep, None)       # ack now, ship in bulk later
                 self._stash_upload(kind, body)
-        # all workers retired (training over): ship the final partial
-        # upload block — it would otherwise die in the box — and beacon a
-        # last telemetry snapshot so the learner's fleet view includes
-        # this relay's complete engine/upload counters
+        self._flush_and_beacon()
+
+    def _flush_and_beacon(self):
+        """End of the relay's life (training over): ship the final partial
+        upload block — it would otherwise die in the box — and beacon a
+        last telemetry snapshot so the learner's fleet view includes
+        this relay's complete engine/upload counters."""
         for kind in list(self._upload_box):
             if self._upload_box[kind]:
                 self._server_rpc((kind, self._upload_box[kind]))
@@ -538,12 +541,128 @@ class Gather:
             pass   # the run is over; a dead link changes nothing
 
 
+def resolve_generation_backend(args: Dict[str, Any]) -> str:
+    """Which actor engine a gather host runs: 'worker' (per-worker
+    inference), 'engine' (per-host InferenceEngine), or 'device' (fused
+    on-device rollouts, DeviceActorGather). A per-host override
+    (``worker_args.backend``, riding the entry handshake) wins over the
+    training config's ``generation.backend``; with neither set, the
+    presence of the inference block picks engine vs worker — exactly the
+    pre-backend-knob behavior."""
+    backend = str((args.get('worker') or {}).get('backend') or ''
+                  ) or str((args.get('generation') or {}).get('backend')
+                           or '')
+    if not backend:
+        backend = ('engine' if (args.get('inference') or {}).get('enabled')
+                   else 'worker')
+    return backend
+
+
+class DeviceActorGather(Gather):
+    """A gather whose 'workers' are lanes of one fused device rollout.
+
+    Reuses ALL of Gather's learner-side plumbing — the supervised server
+    RPC with reconnect, the task-block prefetch, the snapshot LRU, the
+    batched upload box with resend bounds, heartbeats — by initializing the
+    parent with zero worker children and no inference engine. The run loop
+    then pulls task blocks through ``_next_task`` and serves them with a
+    :class:`~.device_generation.DeviceActorEngine`; tasks the compiled
+    program cannot express fall back to a host Generator/Evaluator pair in
+    this same process, so every assigned task is answered either way."""
+
+    def __init__(self, args: Dict[str, Any], server_conn, gather_id: int,
+                 reconnect=None):
+        from .device_generation import DeviceActorEngine
+        from .environment import make_jax_env
+        doctored = dict(args)
+        doctored['worker'] = dict(args['worker'], num_parallel=0)
+        doctored['inference'] = dict(args.get('inference') or {},
+                                     enabled=False)
+        super().__init__(doctored, server_conn, gather_id,
+                         reconnect=reconnect)
+        gen = dict(args.get('generation') or {})
+        n_envs = int(gen.get('device_actor_envs', 64))
+        slots = int(gen.get('device_actor_slots', 2))
+        self.block = max(1, n_envs // 4)      # task-prefetch granularity
+        self.host_env = make_env(args['env'])
+        self.host_env.reset()
+        example_obs = self.host_env.observation(self.host_env.players()[0])
+        self.vault = ModelVault(self._snapshot, example_obs,
+                                capacity=slots + 2)
+        self.device_engine = DeviceActorEngine(
+            make_jax_env(args['env']), self.vault, self.host_env, args,
+            n_envs=n_envs,
+            chunk_steps=int(gen.get('device_actor_chunk_steps', 16)),
+            slots=slots,
+            record_mode=str(gen.get('device_actor_record', '') or ''),
+            seed=int(args.get('seed', 0)) * 1009 + gather_id)
+        self._fallback_gen = Generator(self.host_env, args,
+                                       namespace=gather_id)
+        self._fallback_eval = Evaluator(self.host_env, args)
+        self._m_deferred = telemetry.counter('device_actor_deferred_total')
+        _LOG.info('gather %d: device actor backend (%d lanes, %d slots, '
+                  '%s records)', gather_id, n_envs, slots,
+                  self.device_engine.record_mode)
+
+    def _collect_block(self):
+        """Pull up to one lane-count of tasks; returns (tasks, stop)."""
+        tasks = []
+        while len(tasks) < self.device_engine.n_envs:
+            task = self._next_task()
+            if task is None:
+                return tasks, True
+            if task.get('role') == 'idle':
+                if tasks:
+                    return tasks, False   # serve the partial block now
+                telemetry.counter('worker_idle_tasks_total').inc()
+                time.sleep(min(5.0, float(task.get('wait', 1.0))))
+                continue
+            tasks.append(task)
+        return tasks, False
+
+    def _run_host(self, task):
+        """Host fallback for a task the device program cannot express
+        (unknown opponent, slot overflow, missing sample key). Same
+        payload contract as a worker process; a crash costs one task."""
+        self._m_deferred.inc()
+        kind = 'result' if task.get('role') == 'e' else 'episode'
+        try:
+            models = self.vault.obtain(dict(task.get('model_id', {})))
+            with telemetry.expected_compile('device-actor host fallback'):
+                if task.get('role') == 'e':
+                    payload = self._fallback_eval.execute(models, task)
+                else:
+                    payload = self._fallback_gen.execute(models, task)
+        except Exception:
+            traceback.print_exc()
+            payload = None
+            telemetry.counter('worker_task_failures_total').inc()
+        self._stash_upload(kind, payload)
+
+    def run(self):
+        while True:
+            tasks, stop = self._collect_block()
+            if tasks:
+                uploads, deferred = self.device_engine.run_block(tasks)
+                for kind, payload in uploads:
+                    self._stash_upload(kind, payload)
+                for task in deferred:
+                    self._run_host(task)
+            if stop:
+                break
+        self._flush_and_beacon()
+
+
 def gather_loop(args, conn, gather_id, server_address=None):
+    from .environment import make_jax_env
+    backend = resolve_generation_backend(args)
     inf = args.get('inference') or {}
-    if inf.get('enabled') and str(inf.get('engine_backend', 'cpu')) == 'device':
-        # the engine is the ONE process on this host allowed to claim a
-        # local accelerator (hosts without one fall back to jax's default);
-        # workers stay CPU-pinned either way
+    if (backend == 'device'
+            or (inf.get('enabled')
+                and str(inf.get('engine_backend', 'cpu')) == 'device')):
+        # the rollout/inference engine is the ONE process on this host
+        # allowed to claim a local accelerator (hosts without one fall back
+        # to jax's default); workers stay CPU-pinned either way
         from . import setup_compile_cache
         setup_compile_cache()
     else:
@@ -553,6 +672,21 @@ def gather_loop(args, conn, gather_id, server_address=None):
         def reconnect():
             return connect_socket_connection(server_address,
                                              WorkerServer.WORKER_PORT)
+    if backend == 'device':
+        if make_jax_env(args['env']) is not None:
+            DeviceActorGather(args, conn, gather_id,
+                              reconnect=reconnect).run()
+            return
+        _LOG.warning(
+            'gather %d: generation backend "device" requested but env %r '
+            'has no pure-JAX twin; falling back to the host path',
+            gather_id, (args.get('env') or {}).get('env'))
+    if backend == 'worker' and inf.get('enabled'):
+        # per-host override demoted this gather to plain workers: they
+        # must materialize their own params instead of dialing an engine
+        args = dict(args, inference=dict(inf, enabled=False))
+    elif backend == 'engine' and not inf.get('enabled'):
+        args = dict(args, inference=dict(inf, enabled=True))
     Gather(args, conn, gather_id, reconnect=reconnect).run()
 
 

@@ -48,7 +48,9 @@ DEFAULT_TOLERANCE_PCT = 25.0
 ROW_TOLERANCE_PCT = {
     'bench-ingest': 30.0,      # host threads vs CI scheduler noise
     'bench-actor': 30.0,
+    'bench-actor-device': 30.0,   # fused on-device rollout fleet row
     'bench-serve': 30.0,
+    'bench-serve-device': 30.0,   # device-backed serving engines row
     'bench-headline': 15.0,    # compiled step timing is steadier
     'bench-mesh': 20.0,
 }

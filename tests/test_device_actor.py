@@ -1,0 +1,280 @@
+"""Device actor backend: the fused on-device rollout engine that serves a
+gather host's whole ledger task block (device_generation.DeviceActorEngine,
+worker.DeviceActorGather).
+
+Contracts pinned here:
+
+  * strict envs (TicTacToe, ConnectX): device episodes are BYTE-compatible
+    with the host Generator under identical (seed, sample_key, params) —
+    the records land in the replay buffer indistinguishable;
+  * device-contract envs (HungryGeese, Geister): episodes carry an
+    explicit ``record_version`` stamp — divergence is declared, never
+    silent (slow legs);
+  * league populations: one compiled program serves every pairing via
+    per-slot stacked params; slot overflow defers to the host fallback
+    instead of retracing;
+  * the jax ConnectX twin tracks the host env move for move, including
+    the vectorized rule-based heuristic.
+"""
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.connection import pack
+from handyrl_tpu.device_generation import (DeviceActorEngine,
+                                           resolve_record_mode)
+from handyrl_tpu.environment import make_env, make_jax_env
+from handyrl_tpu.generation import Generator
+from handyrl_tpu.inference import ModelVault
+from handyrl_tpu.league import plan_slots
+from handyrl_tpu.model import ModelWrapper
+
+
+def _train_args(env_name):
+    cfg = apply_defaults({'env_args': {'env': env_name},
+                          'train_args': {'seed': 11}})
+    ta = dict(cfg['train_args'])
+    ta['env'] = cfg['env_args']
+    return ta
+
+
+def _engine(env_name, slots=2, n_envs=6, record=''):
+    ta = _train_args(env_name)
+    env = make_env(ta['env'])
+    env.reset()
+    obs0 = env.observation(env.players()[0])
+    snaps = {}
+
+    def fetch(mid):
+        if mid not in snaps:
+            w = ModelWrapper(env.net(), seed=100 + int(mid))
+            w.ensure_params(obs0)
+            snaps[mid] = w.snapshot()
+        return snaps[mid]
+
+    vault = ModelVault(fetch, obs0, capacity=slots + 2)
+    eng = DeviceActorEngine(make_jax_env(ta['env']), vault,
+                            make_env(ta['env']), ta, n_envs=n_envs,
+                            chunk_steps=8, slots=slots,
+                            record_mode=record, seed=5)
+    return ta, vault, eng
+
+
+def _g_task(key, mids):
+    players = sorted(mids)
+    return {'role': 'g', 'player': players, 'model_id': dict(mids),
+            'sample_key': key, 'task_id': key}
+
+
+# -- units ---------------------------------------------------------------
+
+def test_plan_slots_admission_and_overflow():
+    assign, admitted = plan_slots([[1], [2], [1, 2]], 2)
+    assert assign == {1: 0, 2: 1}
+    assert admitted == [True, True, True]
+    # a third distinct mid overflows: that task is refused, no eviction
+    assign, admitted = plan_slots([[1], [2], [3]], 2)
+    assert assign == {1: 0, 2: 1}
+    assert admitted == [True, True, False]
+    # mids <= 0 (random/none seats) never claim a slot
+    assign, admitted = plan_slots([[0, -1]], 1)
+    assert assign == {}
+    assert admitted == [True]
+
+
+def test_resolve_record_mode():
+    from handyrl_tpu.envs import (jax_connectx, jax_geister,
+                                  jax_hungry_geese, jax_tictactoe)
+    assert resolve_record_mode(jax_tictactoe, recurrent=False) == 'strict'
+    assert resolve_record_mode(jax_connectx, recurrent=False) == 'strict'
+    # recurrence breaks the host byte contract (hidden-state replay)
+    assert resolve_record_mode(jax_tictactoe, recurrent=True) == 'device'
+    assert resolve_record_mode(jax_hungry_geese, recurrent=False) == 'device'
+    assert resolve_record_mode(jax_geister, recurrent=True) == 'device'
+    with pytest.raises(ValueError):
+        resolve_record_mode(jax_hungry_geese, recurrent=False,
+                            requested='strict')
+
+
+def test_config_validates_backend_knobs():
+    ta = apply_defaults({'env_args': {'env': 'TicTacToe'}})['train_args']
+    gen = ta['generation']
+    assert gen['backend'] == ''
+    assert gen['device_actor_envs'] >= 1
+    assert gen['device_actor_record'] in ('', 'strict', 'device')
+    with pytest.raises(AssertionError):
+        apply_defaults({'env_args': {'env': 'TicTacToe'},
+                        'train_args': {'generation': {'backend': 'gpu'}}})
+    with pytest.raises(AssertionError):
+        apply_defaults({'env_args': {'env': 'TicTacToe'},
+                        'train_args': {'generation':
+                                       {'device_actor_record': 'exact'}}})
+
+
+# -- strict byte parity (TicTacToe) --------------------------------------
+
+@pytest.fixture(scope='module')
+def ttt():
+    return _engine('TicTacToe')
+
+
+def test_strict_episodes_byte_match_host_generator(ttt):
+    ta, vault, eng = ttt
+    assert eng.record_mode == 'strict'
+    tasks = [_g_task(k, {0: 1, 1: 1}) for k in range(4)]
+    uploads, deferred = eng.run_block(tasks)
+    assert not deferred
+    by_key = {p['args']['sample_key']: p for k, p in uploads
+              if k == 'episode' and p is not None}
+    assert sorted(by_key) == [0, 1, 2, 3]
+
+    env = make_env(ta['env'])
+    gen = Generator(env, ta)
+    models = {0: vault.model(1), 1: vault.model(1)}
+    for task in tasks:
+        host = gen.execute(models, task)
+        assert pack(by_key[task['sample_key']]) == pack(host), \
+            'device episode for key %s is not byte-identical' \
+            % task['sample_key']
+    # strict records carry no version stamp: they ARE the host format
+    assert all('record_version' not in p for p in by_key.values())
+
+
+def test_league_pairing_and_slot_overflow(ttt):
+    _ta, _vault, eng = ttt
+    # a cross-model pairing plays in ONE lane of the same compiled program
+    uploads, deferred = eng.run_block([_g_task(7, {0: 1, 1: 2})])
+    assert not deferred and uploads[0][1] is not None
+    # three distinct mids into two slots: the overflow task defers to the
+    # host fallback (never a retrace)
+    tasks = [_g_task(0, {0: 1, 1: 1}), _g_task(1, {0: 2, 1: 2}),
+             _g_task(2, {0: 3, 1: 1})]
+    uploads, deferred = eng.run_block(tasks)
+    assert len(uploads) == 2
+    assert [t['task_id'] for t in deferred] == [2]
+
+
+def test_eval_task_returns_deterministic_result(ttt):
+    _ta, _vault, eng = ttt
+    task = {'role': 'e', 'player': [0], 'model_id': {0: 1, 1: -1},
+            'sample_key': 9, 'opponent': 'random', 'task_id': 99}
+    uploads, _ = eng.run_block([dict(task)])
+    kind, first = uploads[0]
+    uploads, _ = eng.run_block([dict(task)])
+    kind2, second = uploads[0]
+    assert kind == kind2 == 'result'
+    assert first['opponent'] == 'random'
+    assert set(first['result']) == {0, 1}
+    # keyed eval draws are deterministic: a ledger re-issue reproduces
+    assert first['result'] == second['result']
+
+
+def test_unservable_tasks_defer(ttt):
+    _ta, _vault, eng = ttt
+    # negative mid in a 'g' seat: only the host fallback can serve it
+    _, deferred = eng.run_block([_g_task(0, {0: 1, 1: -5})])
+    assert len(deferred) == 1
+
+
+# -- device-contract records (slow: bigger nets, longer episodes) --------
+
+@pytest.mark.slow
+@pytest.mark.parametrize('env_name', ['HungryGeese', 'Geister'])
+def test_device_records_are_version_stamped(env_name):
+    from handyrl_tpu.ops.batch import decompress_moments
+    ta, vault, eng = _engine(env_name, n_envs=4)
+    assert eng.record_mode == 'device'
+    P = eng.num_players
+    tasks = [_g_task(k, {p: 1 for p in range(P)}) for k in range(2)]
+    uploads, deferred = eng.run_block(tasks)
+    assert not deferred
+    eps = [p for k, p in uploads if k == 'episode' and p is not None]
+    assert len(eps) == 2
+    for ep in eps:
+        assert ep['record_version'] == 1   # divergence declared, not silent
+        moments = decompress_moments(ep['moment'])
+        assert len(moments) == ep['steps'] > 0
+        assert set(ep['outcome']) == set(range(P))
+
+
+@pytest.mark.slow
+def test_device_records_pass_network_oracle():
+    """Non-recurrent device records must be network-consistent: re-running
+    the SAME params on each recorded observation reproduces the recorded
+    action probability and value (the stamp marks an rng contract change,
+    not a different policy)."""
+    import jax
+    import jax.numpy as jnp
+    from handyrl_tpu.ops.batch import decompress_moments
+    ta, vault, eng = _engine('HungryGeese', n_envs=4)
+    P = eng.num_players
+    uploads, _ = eng.run_block([_g_task(0, {p: 1 for p in range(P)})])
+    ep = next(p for k, p in uploads if k == 'episode' and p is not None)
+    wrapper = vault.model(1)
+    for moment in decompress_moments(ep['moment']):
+        for p in moment['turn']:
+            obs = moment['observation'][p]
+            if obs is None:
+                continue
+            out = wrapper.inference(obs, None)
+            probs = np.asarray(jax.nn.softmax(jnp.asarray(out['policy'])))
+            a = moment['action'][p]
+            assert abs(float(probs[a]) - moment['selected_prob'][p]) < 1e-4
+            assert np.allclose(np.asarray(out['value']).reshape(-1),
+                               np.asarray(moment['value'][p]).reshape(-1),
+                               atol=1e-4)
+
+
+# -- jax ConnectX twin parity --------------------------------------------
+
+def test_jax_connectx_tracks_host_env():
+    import jax
+    from handyrl_tpu.envs import jax_connectx as jcx
+
+    env = make_env({'env': 'ConnectX'})
+    step = jax.jit(jcx.step)
+    rng = np.random.default_rng(3)
+    for game in range(3):
+        env.reset()
+        state = jcx.init_state(1)
+        while not env.terminal():
+            legal = env.legal_actions()
+            mask = np.asarray(jcx.legal_mask(state))[0]
+            assert sorted(legal) == [c for c in range(7) if mask[c] > 0]
+            assert int(np.asarray(jcx.turn(state))[0]) == env.turn()
+            obs = env.observation(env.turn())
+            np.testing.assert_array_equal(
+                np.asarray(jcx.observe(state))[0], obs)
+            a = int(rng.choice(legal))
+            env.play(a)
+            state = step(state, jnp_action(a))
+        assert bool(np.asarray(jcx.terminal(state))[0])
+        out = np.asarray(jcx.outcome(state))[0]
+        host_out = env.outcome()
+        assert float(out[0]) == host_out[0] and float(out[1]) == host_out[1]
+
+
+def jnp_action(a):
+    import jax.numpy as jnp
+    return jnp.asarray([a], jnp.int32)
+
+
+def test_jax_connectx_greedy_matches_rule_based():
+    from handyrl_tpu.envs import jax_connectx as jcx
+
+    env = make_env({'env': 'ConnectX'})
+    rng = np.random.default_rng(9)
+    checked = 0
+    for game in range(4):
+        env.reset()
+        state = jcx.init_state(1)
+        while not env.terminal():
+            want = env.rule_based_action(env.turn())
+            got = int(np.asarray(jcx.greedy_action(state))[0])
+            assert got == want, 'heuristic diverged at ply %d' % checked
+            checked += 1
+            a = int(rng.choice(env.legal_actions()))
+            env.play(a)
+            state = jcx.step(state, jnp_action(a))
+    assert checked > 20
