@@ -70,6 +70,19 @@ SERVE_METRIC = ('service requests/sec (standalone InferenceService, '
                 'synthetic many-client load)')
 SERVE_UNIT = 'requests/sec'
 
+# BENCH_MODE=gateway measures the match-gateway session tier: completed
+# matches/sec through a real gateway subprocess over a real 2-replica
+# fleet (server-held sessions, opponent seats stepped through the fleet,
+# one round trip per client ply), with a mid-run replica SIGKILL — the
+# row must show ZERO dropped sessions (stranded sessions are rebuilt by
+# journal replay). vs_baseline is N-session matches/sec over
+# single-session matches/sec measured by the SAME harness — the session
+# concurrency gain.
+GATEWAY_METRIC = ('gateway matches/sec (MatchGateway over a replicated '
+                  'fleet, server-held sessions, mid-run replica SIGKILL '
+                  'with journal-replay reconstruction)')
+GATEWAY_UNIT = 'matches/sec'
+
 # BENCH_MODE=mesh measures the mesh-sharded learner: SGD steps/sec of the
 # partition-rule-built NamedSharding/jit update step at 1/2/4/8 devices
 # (one subprocess per mesh size — the virtual-device count is fixed before
@@ -136,7 +149,8 @@ def emit(value=0.0, vs_baseline=0.0, **extra):
     metric, unit = {'ingest': (INGEST_METRIC, INGEST_UNIT),
                     'actor': (ACTOR_METRIC, ACTOR_UNIT),
                     'mesh': (MESH_METRIC, MESH_UNIT),
-                    'serve': (SERVE_METRIC, SERVE_UNIT)}.get(
+                    'serve': (SERVE_METRIC, SERVE_UNIT),
+                    'gateway': (GATEWAY_METRIC, GATEWAY_UNIT)}.get(
                         _active_mode(), (METRIC, UNIT))
     line = {'metric': metric, 'value': round(float(value), 2), 'unit': unit,
             'vs_baseline': round(float(vs_baseline), 2),
@@ -1236,6 +1250,192 @@ def run_serve(probe: dict):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_gateway(probe: dict):
+    """BENCH_MODE=gateway: the match-gateway session tier, CPU-measurable.
+
+    Env knobs (CI smoke shrinks them): BENCH_GATEWAY_SESSIONS (concurrent
+    sessions, default 8), BENCH_GATEWAY_MATCHES (matches per session,
+    default 2), BENCH_GATEWAY_ENV (default TicTacToe — short matches, so
+    the rate measures the session machinery, not the game), and
+    BENCH_GATEWAY_REPLICAS (default 2). BENCH_GATEWAY_KILL=0 disables the
+    mid-run replica SIGKILL (on by default: the row's dropped_sessions=0
+    under the kill IS the robustness headline).
+    """
+    import contextlib
+    import random
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import numpy as np
+    import handyrl_tpu
+    handyrl_tpu.honor_platform_env()
+    from handyrl_tpu.environment import make_env
+    from handyrl_tpu.model import ModelWrapper
+    from handyrl_tpu.serving.fleet import RoutedClient
+    from handyrl_tpu.serving.gateway import GatewayClient
+    from handyrl_tpu.serving.registry import ModelRegistry
+
+    env_name = os.environ.get('BENCH_GATEWAY_ENV', 'TicTacToe')
+    n_sessions = int(os.environ.get('BENCH_GATEWAY_SESSIONS', '8'))
+    matches = int(os.environ.get('BENCH_GATEWAY_MATCHES', '2'))
+    replicas = int(os.environ.get('BENCH_GATEWAY_REPLICAS', '2'))
+    kill = os.environ.get('BENCH_GATEWAY_KILL', '1') != '0'
+
+    env = make_env({'env': env_name})
+    env.reset()
+    obs = env.observation(env.players()[0])
+    wrapper = ModelWrapper(env.net(), seed=7)
+    wrapper.ensure_params(obs)
+
+    root = tempfile.mkdtemp(prefix='bench_gateway_registry.')
+    fleet_proc = gw_proc = rc = None
+    try:
+        with contextlib.redirect_stdout(sys.stderr):
+            ModelRegistry(root).publish('bench', snapshot=wrapper.snapshot(),
+                                        version=1, steps=1, promote=True)
+        fleet_proc = subprocess.Popen(
+            [sys.executable, '-m', 'handyrl_tpu.serving', '--fleet',
+             '--env', env_name, '--registry', root, '--port', '0',
+             '--line', 'bench', '--replicas', str(replicas),
+             '--heartbeat', '0.3'],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        _CHILDREN.append(fleet_proc)
+        fleet_port = int(json.loads(
+            fleet_proc.stdout.readline())['fleet_ready']['port'])
+        gw_proc = subprocess.Popen(
+            [sys.executable, '-m', 'handyrl_tpu.serving', '--gateway',
+             '--resolver', 'localhost:%d' % fleet_port,
+             '--registry', root, '--env', env_name,
+             '--gateway-model', 'bench@champion',
+             '--gateway-workers', str(min(8, n_sessions)),
+             '--max-sessions', str(n_sessions + 4), '--seed', '11'],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        _CHILDREN.append(gw_proc)
+        gport = int(json.loads(
+            gw_proc.stdout.readline())['gateway_ready']['port'])
+
+        ply_lat = []
+        lat_lock = threading.Lock()
+        errors = [0]
+
+        def play_matches(ci, n, collect=True):
+            rng = random.Random(1000 + ci)
+            done = 0
+            cl = GatewayClient('localhost', gport, timeout=60.0,
+                               name='b%d' % ci)
+            try:
+                for _ in range(n):
+                    r = cl.open(env_name, seat=0)
+                    sid = r['sid']
+                    while not r.get('done'):
+                        action = (rng.choice(r['legal'])
+                                  if r.get('to_move') and r.get('legal')
+                                  else None)
+                        t0 = time.monotonic()
+                        r = cl.play(sid, action)
+                        if collect:
+                            with lat_lock:
+                                ply_lat.append(time.monotonic() - t0)
+                    done += 1
+            except Exception:   # noqa: BLE001 — reported in the row
+                errors[0] += 1
+            finally:
+                cl.close()
+            return done
+
+        # one warmup match first (replica engines compile on first touch),
+        # then the single-session reference: the vs_baseline denominator
+        play_matches(0, 1, collect=False)
+        t0 = time.monotonic()
+        base_done = play_matches(0, max(2, matches), collect=False)
+        base_rate = base_done / max(time.monotonic() - t0, 1e-9)
+
+        # N concurrent sessions, a replica SIGKILLed mid-run: every match
+        # must still complete (stranded sessions rebuilt by journal replay)
+        rc = RoutedClient('localhost', fleet_port, timeout=30.0)
+        table = {r['replica']: r for r in rc.replicas()}
+        victim = sorted(table)[0] if (kill and len(table) > 1) else None
+        completed = [0] * n_sessions
+        threads = [threading.Thread(
+            target=lambda ci=ci: completed.__setitem__(
+                ci, play_matches(ci, matches)),
+            name='bench-gw-%d' % ci) for ci in range(n_sessions)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        if victim is not None:
+            time.sleep(0.5)
+            try:
+                os.kill(int(table[victim]['pid']), _signal.SIGKILL)
+            except (OSError, KeyError, TypeError):
+                victim = None
+        for t in threads:
+            t.join(timeout=300)
+        many_rate = sum(completed) / max(time.monotonic() - t0, 1e-9)
+
+        status_cl = GatewayClient('localhost', gport, timeout=30.0,
+                                  name='bstatus')
+        status = status_cl.status()
+        status_cl.close()
+
+        # gateway SIGTERM drains to exit 75 (the supervisor contract),
+        # then the fleet follows
+        gw_proc.send_signal(_signal.SIGTERM)
+        try:
+            gw_exit = gw_proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            gw_proc.terminate()
+            gw_exit = None
+        fleet_proc.send_signal(_signal.SIGTERM)
+        try:
+            fleet_exit = fleet_proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            fleet_proc.terminate()
+            fleet_exit = None
+
+        lat_ms = sorted(1e3 * v for v in ply_lat)
+        pct = (lambda q: round(float(np.percentile(lat_ms, q)), 2)) \
+            if lat_ms else (lambda q: 0.0)
+        emit(many_rate, (many_rate / base_rate) if base_rate else 0.0,
+             backend=probe.get('backend', 'unknown'),
+             device=probe.get('device_kind', 'unknown'),
+             env=env_name, sessions=n_sessions,
+             matches_per_session=matches,
+             matches_completed=sum(completed),
+             fleet_replicas=replicas,
+             host_cores=os.cpu_count() or 1,
+             single_session_matches_per_sec=round(base_rate, 2),
+             ply_p50_ms=pct(50), ply_p95_ms=pct(95), ply_p99_ms=pct(99),
+             plies_measured=len(lat_ms),
+             killed_replica=victim,
+             dropped_sessions=int(status.get('dropped', 0)),
+             reconstructs=int(status.get('reconstructs', 0)),
+             replayed_plies=int(status.get('replayed_plies', 0)),
+             reconstruct_mismatches=int(status.get('mismatches', 0)),
+             handoffs=int(status.get('handoffs', 0)),
+             shed_total=int(status.get('shed', 0)),
+             outcomes_recorded=int(status.get('outcomes', 0)),
+             client_errors=errors[0],
+             gateway_drain_exit_code=gw_exit,
+             fleet_drain_exit_code=fleet_exit,
+             vs_baseline_def=('%d-session matches/s over single-session '
+                              'matches/s against the same gateway — the '
+                              'session concurrency gain' % n_sessions),
+             geometry=('headline'
+                       if (n_sessions >= 8 and matches >= 2
+                           and env_name == 'TicTacToe') else 'dryrun'))
+    finally:
+        if rc is not None:
+            rc.close()
+        for proc in (gw_proc, fleet_proc):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _last_measured() -> str:
     """The newest on-silicon bench-headline row, summarized for the
     backend-unavailable JSON line — so a wedged tunnel at the driver's
@@ -1290,6 +1490,8 @@ def main():
             run_mesh(probe)
         elif _active_mode() == 'serve':
             run_serve(probe)
+        elif _active_mode() == 'gateway':
+            run_gateway(probe)
         else:
             run_bench(probe)
     except Exception as exc:  # noqa: BLE001 — the contract is: always emit

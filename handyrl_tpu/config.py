@@ -162,6 +162,23 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
             'quarantine_period': 30.0,   # quarantine length (s) before a silent replica is speculatively re-admitted (a re-registration re-admits it immediately)
             'metrics_port': 0,           # resolver-side Prometheus /metrics + /statusz port (0 = exporter off); the fleet's alert engine and replica-state view live here
         },
+
+        # match gateway (serving/gateway.py, docs/serving.md "Match
+        # gateway"): the sessionful tier over the fleet — clients open
+        # matches, the gateway hosts the env, steps opponent seats through
+        # the replicas, and survives replica loss by hidden-state handoff
+        # (drain) or byte-identical journal reconstruction (SIGKILL)
+        'gateway': {
+            'port': 0,               # gateway listen port (main.py --gateway); 0 = ephemeral (reported on the gateway_ready line)
+            'resolver': '',          # 'host:port' of the fleet resolver the gateway routes plies through; '' = serving.fleet.resolver
+            'model': 'default@champion',  # opponent spec a session opens against when the client names none; floating selectors are pinned to a concrete line@version at open, so a mid-match promote never forks the opponent
+            'workers': 4,            # session worker threads; each owns its own RoutedClient, so concurrent sessions' plies coalesce into the engine batch without sharing a submitter
+            'max_sessions': 64,      # admission control: opens past this are shed with an error reply (gateway_shed_total) — opens are shed, plies never are
+            'ply_timeout': 15.0,     # per-ply fleet round-trip deadline (s); also bounds reconstruction replays
+            'monitor_interval': 0.5, # fleet-table poll period (s) for the handoff/reconstruct monitor (and the worker routers' refresh interval)
+            'session_timeout': 600.0,  # idle sessions (no ply this long, s) are reaped as drops — an abandoned match must not pin fleet affinity forever
+            'metrics_port': 0,       # gateway-side Prometheus /metrics port (0 = exporter off)
+        },
     },
 
     # league training (league.py, docs/league.md): PFSP opponent sampling
@@ -433,6 +450,26 @@ def validate(args: Dict[str, Any]) -> None:
         assert r_port.isdigit() and 0 < int(r_port) <= 65535, \
             "serving.fleet.resolver must look like 'host:port' (got %r)" \
             % resolver
+    gw = srv.get('gateway') or {}
+    for key in ('port', 'metrics_port'):
+        if gw.get(key) is not None:
+            assert 0 <= int(gw[key]) <= 65535, \
+                'serving.gateway.%s must be a TCP port (0 = %s)' % (
+                    key, 'ephemeral' if key == 'port' else 'exporter off')
+    assert int(gw.get('workers', 4)) >= 1, \
+        'serving.gateway.workers must be >= 1'
+    assert int(gw.get('max_sessions', 64)) >= 1, \
+        'serving.gateway.max_sessions must be >= 1'
+    for key in ('ply_timeout', 'monitor_interval', 'session_timeout'):
+        if gw.get(key) is not None:
+            assert float(gw[key]) > 0, \
+                'serving.gateway.%s must be > 0' % key
+    gw_resolver = str(gw.get('resolver') or '')
+    if gw_resolver:
+        _g_host, _, g_port = gw_resolver.rpartition(':')
+        assert g_port.isdigit() and 0 < int(g_port) <= 65535, \
+            "serving.gateway.resolver must look like 'host:port' (got %r)" \
+            % gw_resolver
     lg = ta.get('league') or {}
     assert str(lg.get('curve', 'variance')) in \
         ('variance', 'hard', 'uniform'), \

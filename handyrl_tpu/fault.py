@@ -219,6 +219,105 @@ class TaskLedger:
         return events
 
 
+class SessionLedger:
+    """Session-affinity book for the match gateway: which replica each
+    open session's recurrent state is warm on.
+
+    The gateway keeps the authoritative hidden-state cache; this ledger
+    only tracks the *affinity* (the replica whose engine last saw the
+    session, so consecutive plies coalesce into the same engine batch)
+    and journals the strandings when a replica dies. ``fail_replica``
+    strands every session booked on a replica and returns them — the
+    gateway then either hands each session off (its cached hidden rides
+    the next request to a survivor) or replay-reconstructs it from the
+    session journal. Mirrors :class:`TaskLedger`'s stranding telemetry so
+    postmortems correlate session loss with host-state transitions."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._sessions: Dict[Any, Any] = {}          # sid -> replica
+        self._by_replica: Dict[Any, set] = defaultdict(set)
+        self._strandings: deque = deque(maxlen=4096)  # (sid, replica, why, t)
+        self.stats: Dict[str, int] = {
+            'booked': 0, 'moved': 0, 'released': 0,
+            'stranded': 0, 'replica_failures': 0,
+        }
+
+    def book(self, sid, replica) -> None:
+        """Bind a fresh session to the replica that served its first ply."""
+        self.release(sid)
+        self._sessions[sid] = replica
+        self._by_replica[replica].add(sid)
+        self.stats['booked'] += 1
+
+    def move(self, sid, replica) -> Optional[Any]:
+        """Re-pin ``sid`` (handoff / reconstruct landed elsewhere);
+        returns the previous replica, or None if the session is new."""
+        prev = self._sessions.get(sid)
+        if prev == replica:
+            return prev
+        if prev is not None:
+            owners = self._by_replica.get(prev)
+            if owners is not None:
+                owners.discard(sid)
+                if not owners:
+                    self._by_replica.pop(prev, None)
+            self.stats['moved'] += 1
+        else:
+            self.stats['booked'] += 1
+        self._sessions[sid] = replica
+        self._by_replica[replica].add(sid)
+        return prev
+
+    def release(self, sid) -> bool:
+        """Close the book on a finished/abandoned session."""
+        replica = self._sessions.pop(sid, None)
+        if replica is None:
+            return False
+        owners = self._by_replica.get(replica)
+        if owners is not None:
+            owners.discard(sid)
+            if not owners:
+                self._by_replica.pop(replica, None)
+        self.stats['released'] += 1
+        return True
+
+    def replica_of(self, sid) -> Optional[Any]:
+        return self._sessions.get(sid)
+
+    def sessions_on(self, replica) -> list:
+        return sorted(self._by_replica.get(replica, ()))
+
+    def fail_replica(self, replica, reason: str = 'detach') -> list:
+        """Strand every session pinned to a dead/draining replica; the
+        caller decides handoff vs replay-reconstruct per session."""
+        sids = self.sessions_on(replica)
+        now = self._clock()
+        for sid in sids:
+            self._sessions.pop(sid, None)
+            self._strandings.append((sid, replica, reason, now))
+            telemetry.record_event('session_stranding', str(replica),
+                                   reason=reason, session=str(sid))
+        self._by_replica.pop(replica, None)
+        self.stats['stranded'] += len(sids)
+        if sids:
+            self.stats['replica_failures'] += 1
+        return sids
+
+    def outstanding(self) -> int:
+        return len(self._sessions)
+
+    def outstanding_by_replica(self) -> Dict[Any, int]:
+        return {rep: len(sids) for rep, sids in self._by_replica.items()
+                if sids}
+
+    def drain_stranding_events(self):
+        """Consume the (sid, replica, reason, time) stranding journal."""
+        events = list(self._strandings)
+        self._strandings.clear()
+        return events
+
+
 # host health states, in escalation order (numeric codes for the
 # fleet_host_state gauge live in telemetry.HOST_STATE_CODES)
 HOST_HEALTHY = 'healthy'

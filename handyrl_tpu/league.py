@@ -161,6 +161,28 @@ class RatingBook:
                                'sigma': self.initial_sigma,
                                'games': 0, 'wins': 0.0}
 
+    def seed_provisional(self, name: str, rating: Optional[float] = None
+                         ) -> Dict[str, float]:
+        """Create (or return) a *provisional* member: an unrated outsider
+        — a gateway player, a guest bot — seeded at the learner's current
+        rating with full (high) sigma so its first games move it fast.
+        Provisional members never feed the promotion gate (their games
+        don't count toward ``min_games`` and they can never be a champion
+        candidate — champions come from the registry manifest)."""
+        e = self._entries.get(name)
+        if e is not None:
+            return e
+        if rating is None:
+            rating = self.rating(LEARNER)
+        e = {'rating': float(rating), 'sigma': self.initial_sigma,
+             'games': 0, 'wins': 0.0, 'provisional': True}
+        self._entries[name] = e
+        return e
+
+    def is_provisional(self, name: str) -> bool:
+        e = self._entries.get(name)
+        return bool(e is not None and e.get('provisional'))
+
     def rating(self, name: str) -> float:
         e = self._entries.get(name)
         return self.initial_rating if e is None else float(e['rating'])
@@ -213,7 +235,32 @@ class RatingBook:
         member['wins'] += s  # learner's score vs this member (PFSP input)
         self._shrink(learner)
         self._shrink(member)
-        self.games_since_promote += 1
+        if not member.get('provisional'):
+            # Games against outsiders calibrate their rating but say
+            # nothing about the learner vs the league — they never feed
+            # the min_games promotion gate.
+            self.games_since_promote += 1
+
+    def record_between(self, a: str, b: str, score_a: float) -> None:
+        """Book one game between two named members, neither the learner —
+        the gateway path (external player vs a served ``line@version``).
+
+        Ratings move by standard Elo with per-side effective K; the
+        promotion gate is untouched.  Per-member (games, wins) are
+        *learner-relative* PFSP statistics, so only provisional entries
+        accumulate them here (as their own score); a rated member's PFSP
+        win-rate is never polluted by third-party matches."""
+        s = min(max(float(score_a), 0.0), 1.0)
+        ea, eb = self.entry(a), self.entry(b)
+        expected = 1.0 / (1.0 + 10.0 ** ((eb['rating']
+                                          - ea['rating']) / 400.0))
+        ea['rating'] += self._k(ea) * (s - expected)
+        eb['rating'] += self._k(eb) * ((1.0 - s) - (1.0 - expected))
+        for e, own in ((ea, s), (eb, 1.0 - s)):
+            if e.get('provisional'):
+                e['games'] += 1
+                e['wins'] += own
+                self._shrink(e)
 
     def note_promotion(self) -> None:
         self.promotions += 1

@@ -68,6 +68,24 @@ def main(argv=None) -> int:
     ap.add_argument('--slo-p99-ms', type=float, default=None,
                     help='autoscaler p99 latency target '
                          '(serving.fleet.slo_p99_ms)')
+    # gateway mode: match gateway over an existing fleet resolver
+    ap.add_argument('--gateway', action='store_true',
+                    help='run a match gateway (server-held game sessions '
+                         'over a fleet resolver) instead of a service')
+    ap.add_argument('--gateway-model', default=None,
+                    help='default opponent model spec '
+                         '(serving.gateway.model)')
+    ap.add_argument('--gateway-workers', type=int, default=None,
+                    help='session worker threads '
+                         '(serving.gateway.workers)')
+    ap.add_argument('--max-sessions', type=int, default=None,
+                    help='admission-control ceiling '
+                         '(serving.gateway.max_sessions)')
+    ap.add_argument('--ply-timeout', type=float, default=None,
+                    help='per-ply inference deadline '
+                         '(serving.gateway.ply_timeout)')
+    ap.add_argument('--seed', type=int, default=None,
+                    help='base seed for audited per-session env seeds')
     args = ap.parse_args(argv)
 
     from ..config import apply_defaults
@@ -80,6 +98,20 @@ def main(argv=None) -> int:
     if args.engine_backend is not None:
         inference['engine_backend'] = args.engine_backend
     fleet = {}
+    gateway = {}
+    if args.gateway:
+        gateway['port'] = args.port
+        gateway['metrics_port'] = args.metrics_port
+        if args.resolver:
+            gateway['resolver'] = args.resolver
+        if args.gateway_model is not None:
+            gateway['model'] = args.gateway_model
+        if args.gateway_workers is not None:
+            gateway['workers'] = int(args.gateway_workers)
+        if args.max_sessions is not None:
+            gateway['max_sessions'] = int(args.max_sessions)
+        if args.ply_timeout is not None:
+            gateway['ply_timeout'] = float(args.ply_timeout)
     if args.resolver:
         fleet['resolver'] = args.resolver
     if args.replica:
@@ -100,21 +132,32 @@ def main(argv=None) -> int:
             fleet['autoscale'] = True
         if args.slo_p99_ms is not None:
             fleet['slo_p99_ms'] = float(args.slo_p99_ms)
+    train_args = {
+        'inference': inference,
+        'serving': {
+            'port': args.port, 'host': args.host, 'line': args.line,
+            'registry_dir': args.registry, 'engines': args.engines,
+            'max_clients': args.max_clients,
+            'drain_timeout': args.drain_timeout,
+            'metrics_port': args.metrics_port,
+            'fleet': fleet,
+            'gateway': gateway,
+        },
+    }
+    if args.gateway:
+        # gateway binds its own port; keep the service-layer port at the
+        # argparse default so validate() does not see a double booking
+        train_args['serving']['port'] = 0
+        if args.seed is not None:
+            train_args['seed'] = int(args.seed)
     cfg = apply_defaults({
         'env_args': {'env': args.env},
-        'train_args': {
-            'inference': inference,
-            'serving': {
-                'port': args.port, 'host': args.host, 'line': args.line,
-                'registry_dir': args.registry, 'engines': args.engines,
-                'max_clients': args.max_clients,
-                'drain_timeout': args.drain_timeout,
-                'metrics_port': args.metrics_port,
-                'fleet': fleet,
-            },
-        },
+        'train_args': train_args,
     })
-    if args.fleet:
+    if args.gateway:
+        from .gateway import gateway_main
+        gateway_main(cfg, [])
+    elif args.fleet:
         from .fleet import resolver_main
         resolver_main(cfg, [])
     else:

@@ -193,6 +193,14 @@ class ServiceClient:
                                % body.get('op'))
         return reply[1]
 
+    def call_admin(self, body: Dict[str, Any],
+                   timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Public admin round-trip: send one SERVE_KIND frame, await the
+        reply body. The gateway client drives its whole session protocol
+        (open/play/close) through this — admin frames interleave safely
+        with in-flight inference replies (see :meth:`_await`)."""
+        return self._call_admin(dict(body), timeout)
+
     def status(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         """The service's live stats: lines/champions, request counters,
         drain state."""

@@ -759,6 +759,10 @@ class RoutedClient:
         self._rid = 0
         # rid -> (replica, replica-local rid, request kwargs) for replay
         self._book: Dict[int, Tuple[str, int, Dict[str, Any]]] = {}
+        # floating spec -> (concrete line@version, expiry) — see _pin_spec
+        self._pins: Dict[str, Tuple[str, float]] = {}  # guarded-by: _lock
+        # replica that served the most recent dispatch (submit or replay)
+        self.last_replica: Optional[str] = None
         self._m_requests = lambda replica: telemetry.counter(
             'router_requests_total', replica=replica)
         self._m_replays = telemetry.counter('router_replays_total')
@@ -858,13 +862,48 @@ class RoutedClient:
         if client is not None:
             client.close()
 
-    def _dispatch(self, req: Dict[str, Any]) -> Tuple[str, int]:
+    def _pin_spec(self, spec: str) -> str:
+        """Resolve a floating selector (``champion``/``previous``/
+        ``latest``) to the concrete ``line@version`` it names right now,
+        cached for one refresh interval and invalidated by :meth:`promote`.
+        A request stranded mid-promote then replays against the SAME
+        version it was first dispatched with — the byte-identity contract
+        holds across a champion flip.  Concrete specs pass through;
+        resolve failure degrades to the raw spec (each replica resolves
+        it locally, as before pinning existed)."""
+        line, selector = parse_spec(str(spec))
+        if selector not in ('champion', 'previous', 'latest'):
+            return str(spec)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._pins.get(spec)
+            if hit is not None and hit[1] > now:
+                return hit[0]
+        try:
+            reply = self.resolve(spec, timeout=self.timeout)
+        except (ServiceUnavailable, TimeoutError):
+            return str(spec)
+        if reply.get('error') or reply.get('version') is None:
+            return str(spec)
+        pinned = '%s@%s' % (reply.get('line') or line, reply['version'])
+        with self._lock:
+            self._pins[str(spec)] = (pinned, now + self._refresh_interval)
+        return pinned
+
+    def _dispatch(self, req: Dict[str, Any],
+                  prefer: Optional[str] = None) -> Tuple[str, int]:
         """Send ``req`` to the first admissible replica; (replica, local
         rid). Dial/send failures open that replica's breaker and move on;
-        a second pass runs after a forced table refresh."""
+        a second pass runs after a forced table refresh.  ``prefer`` moves
+        a session-affine replica to the front of the candidate order when
+        it is still routable (gateway affinity — never a hard pin)."""
         last: Optional[BaseException] = None
         for _attempt in range(2):
-            for name in self._candidates():
+            names = self._candidates()
+            if prefer is not None and prefer in names:
+                names.remove(prefer)
+                names.insert(0, prefer)
+            for name in names:
                 breaker = self._breaker(name)
                 breaker.begin_probe()
                 try:
@@ -875,6 +914,7 @@ class RoutedClient:
                     self._fail(name)
                     continue
                 self._m_requests(name).inc()
+                self.last_replica = name
                 return name, sub
             self._refresh(force=True)
         raise ServiceUnavailable(
@@ -884,11 +924,11 @@ class RoutedClient:
     # -- the ServiceClient surface -----------------------------------------
 
     def submit(self, model: str, obs, hidden=None, legal=None,
-               seed=None) -> int:
-        req = {'model': str(model), 'obs': obs, 'hidden': hidden,
-               'legal': legal, 'seed': seed}
+               seed=None, replica: Optional[str] = None) -> int:
         self._refresh()
-        name, sub = self._dispatch(req)
+        req = {'model': self._pin_spec(model), 'obs': obs, 'hidden': hidden,
+               'legal': legal, 'seed': seed}
+        name, sub = self._dispatch(req, prefer=replica)
         with self._lock:
             self._rid += 1
             rid = self._rid
@@ -939,9 +979,11 @@ class RoutedClient:
             from last
 
     def request(self, model: str, obs, hidden=None, legal=None, seed=None,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                replica: Optional[str] = None) -> Dict[str, Any]:
         return self.collect(self.submit(model, obs, hidden=hidden,
-                                        legal=legal, seed=seed),
+                                        legal=legal, seed=seed,
+                                        replica=replica),
                             timeout=timeout)
 
     def status(self, timeout: Optional[float] = None) -> Dict[str, Any]:
@@ -951,9 +993,13 @@ class RoutedClient:
     def promote(self, spec: str, timeout: float = 600.0) -> Dict[str, Any]:
         """Rolling-promote ``line@selector`` across the fleet (blocks
         until every routable replica warmed and the champion flipped)."""
-        return self._resolver._call_admin({'op': 'promote',
+        reply = self._resolver._call_admin({'op': 'promote',
                                            'model': str(spec)},
                                           timeout=timeout)
+        with self._lock:
+            # the flip just moved every floating selector; drop stale pins
+            self._pins.clear()
+        return reply
 
     def resolve(self, spec: str, timeout: Optional[float] = None
                 ) -> Dict[str, Any]:

@@ -59,6 +59,17 @@ from .registry import ModelRegistry, RegistryError, parse_spec
 _LOG = telemetry.get_logger('serving')
 
 
+def ring_percentile_ms(lats, q: float) -> float:
+    """Nearest-rank percentile of a latency ring (seconds), in ms — the
+    one SLO-snapshot definition shared by the service heartbeat and the
+    gateway's per-ply latency gauge, so 'p99_ms' means the same thing on
+    every surface."""
+    if not lats:
+        return 0.0
+    lats = sorted(lats)
+    return 1e3 * lats[int(round((len(lats) - 1) * float(q)))]
+
+
 class _WarmSink:
     """Reply endpoint for synthetic warm-up requests (the rolling-promote
     walk): the engine's reply lands here instead of a client socket, so a
@@ -470,9 +481,7 @@ class InferenceService:
             inflight = len(self._pending)
 
         def pct(q: float) -> float:
-            if not lats:
-                return 0.0
-            return 1e3 * lats[int(round((len(lats) - 1) * q))]
+            return ring_percentile_ms(lats, q)
 
         return {'p50_ms': pct(0.50), 'p99_ms': pct(0.99),
                 'inflight': inflight,

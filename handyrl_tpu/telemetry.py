@@ -1039,6 +1039,18 @@ BUILTIN_ALERTS: Tuple[Dict[str, Any], ...] = (
      'metric': 'league_games_total', 'kind': 'rate',
      'op': '<=', 'threshold': 0.0, 'for': 120.0,
      'arm_metric': 'league_games_total'},
+    # match gateway (docs/serving.md "Match gateway"): the zero-loss
+    # session contract — ANY dropped session is an incident (armed once
+    # the gateway has ever opened one), and the per-ply latency SLO the
+    # session tier promises on top of the fleet's request SLO
+    {'name': 'session_drop',
+     'metric': 'gateway_session_drops_total', 'kind': 'rate',
+     'op': '>', 'threshold': 0.0, 'clear_for': 60.0,
+     'arm_metric': 'gateway_sessions_opened_total'},
+    {'name': 'gateway_ply_slo',
+     'metric': 'gateway_ply_p99_ms', 'kind': 'value',
+     'op': '>', 'threshold': 250.0, 'for': 15.0, 'clear_for': 30.0,
+     'arm_metric': 'gateway_plies_total'},
 )
 
 _ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {

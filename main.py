@@ -19,6 +19,9 @@ modes:
   --serve-fleet, -sf   replicated serving fleet: resolver/router +
                        serving.fleet.replicas managed replicas (SLO-driven
                        autoscaling, zero-loss failover, rolling promotes)
+  --gateway, -gw       match gateway over a serving fleet: server-held
+                       game sessions (open/play/close), drain handoff +
+                       journal-replay reconstruction, outcomes -> RatingBook
   --status             render a live /statusz health view [HOST:PORT]
                        (active alerts, fleet states, progress, recorder)
 """
@@ -69,6 +72,9 @@ def main():
     elif mode in ('--serve-fleet', '-sf'):
         from handyrl_tpu.serving.fleet import resolver_main
         resolver_main(args, rest)
+    elif mode in ('--gateway', '-gw'):
+        from handyrl_tpu.serving.gateway import gateway_main
+        gateway_main(args, rest)
     elif mode == '--status':
         from handyrl_tpu.telemetry import status_main
         status_main(args.get('train_args'), rest)

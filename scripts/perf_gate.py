@@ -51,6 +51,7 @@ ROW_TOLERANCE_PCT = {
     'bench-actor-device': 30.0,   # fused on-device rollout fleet row
     'bench-serve': 30.0,
     'bench-serve-device': 30.0,   # device-backed serving engines row
+    'bench-gateway': 30.0,        # session tier: subprocess + chaos noise
     'bench-headline': 15.0,    # compiled step timing is steadier
     'bench-mesh': 20.0,
 }

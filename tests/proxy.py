@@ -12,7 +12,11 @@ the four transport faults the fleet must survive:
   stalled-service shape (a wedged inference engine, a hung RPC server)
   that request deadlines and the engine watchdog exist for, distinct from
   ``blackhole`` where not even the request arrives;
-* ``delay``     — per-chunk forwarding latency (slow WAN links).
+* ``delay``     — per-chunk forwarding latency (slow WAN links);
+* ``flap``      — periodic sever/restore on a configurable period (a link
+  that bounces: every half-period the proxy severs all connections and
+  stops accepting, then restores — the deterministic mid-match failover
+  driver for session handoff/reconstruct tests).
 """
 
 import socket
@@ -52,7 +56,9 @@ class ChaosProxy:
         self.stall = False
         self.delay = 0.0
         self.accepted = 0
+        self.flaps = 0
         self._closed = False
+        self._flap_stop = None
         threading.Thread(target=self._accept_loop, name='proxy-accept',
                  daemon=True).start()
 
@@ -107,8 +113,39 @@ class ChaosProxy:
             for s in pair:
                 _hard_close(s)
 
+    def flap(self, period: float):
+        """Bounce the link every ``period`` seconds: down for half a period
+        (sever + refuse new connections), up for the other half. Call
+        ``stop_flap()`` (or ``close()``) to end with the link restored."""
+        self.stop_flap()
+        stop = threading.Event()
+        self._flap_stop = stop
+
+        def _loop():
+            half = max(float(period), 1e-3) / 2.0
+            while not stop.wait(half):
+                self.accepting = False
+                self.sever()
+                self.flaps += 1
+                if stop.wait(half):
+                    break
+                self.accepting = True
+            self.accepting = True
+
+        threading.Thread(target=_loop, name='proxy-flap', daemon=True).start()
+
+    def stop_flap(self):
+        """Stop a running flap loop and restore the link."""
+        stop, self._flap_stop = self._flap_stop, None
+        if stop is not None:
+            stop.set()
+        self.accepting = True
+
     def close(self):
         self._closed = True
+        if self._flap_stop is not None:
+            self._flap_stop.set()
+            self._flap_stop = None
         try:
             self._lsock.close()
         except OSError:

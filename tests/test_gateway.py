@@ -1,0 +1,383 @@
+"""Match-gateway tests (serving/gateway.py, docs/serving.md "Match
+gateway"): the deterministic session primitives (hidden-state digest,
+audited per-session seeding), the SessionLedger affinity book, the
+ChaosProxy ``flap`` fault mode the handoff/reconstruct chaos legs drive
+with, and the gateway itself end to end against an in-process fleet —
+session lifecycle, admission shed, protocol errors, outcome booking into
+the RatingBook, and byte-identical journal reconstruction (including the
+tampered-journal mismatch path)."""
+
+import os
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.config import apply_defaults
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.league import LEARNER, journal_path, make_rating_book
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.serving.registry import ModelRegistry
+
+
+def _ttt_wrapper(seed=7):
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    w = ModelWrapper(env.net(), seed=seed)
+    w.ensure_params(env.observation(0))
+    return env, w
+
+
+def _fleet_args(root, resolver_port=None, **flt):
+    fleet = dict(flt)
+    if resolver_port is not None:
+        fleet['resolver'] = '127.0.0.1:%d' % resolver_port
+    args = apply_defaults({
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'serving': {'port': 0, 'registry_dir': str(root),
+                                   'fleet': fleet}},
+    })['train_args']
+    args['env'] = {'env': 'TicTacToe'}
+    return args
+
+
+def _gw_args(root, resolver_port, **gw):
+    gateway = dict({'resolver': '127.0.0.1:%d' % resolver_port,
+                    'workers': 1, 'monitor_interval': 0.2}, **gw)
+    args = apply_defaults({
+        'env_args': {'env': 'TicTacToe'},
+        'train_args': {'serving': {'port': 0, 'registry_dir': str(root),
+                                   'gateway': gateway}},
+    })['train_args']
+    args['env'] = {'env': 'TicTacToe'}
+    args['seed'] = 11
+    return args
+
+
+def _in_process_fleet(tmp_path, replicas=1):
+    """A resolver plus ``replicas`` self-registering in-process services
+    over a published TicTacToe champion; returns (resolver, services, w)."""
+    from handyrl_tpu.serving.fleet import ServiceResolver
+    from handyrl_tpu.serving.service import InferenceService
+    _, w = _ttt_wrapper()
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_timeout=60.0)).start()
+    services = [InferenceService(_fleet_args(
+        tmp_path, resolver_port=resolver.port,
+        heartbeat_interval=0.1)).start() for _ in range(replicas)]
+    assert resolver.wait_routable(replicas, timeout=60)
+    return resolver, services, w
+
+
+def _gateway_close(gw):
+    router = getattr(gw._tl, 'router', None)
+    if router is not None:
+        router.close()
+
+
+def _play_to_outcome(gw, sid, reply, rng):
+    """Drive a session to terminal through direct ``_op_play`` calls,
+    returning (outcome, plies_played_by_client)."""
+    plies = 0
+    while not reply.get('done'):
+        action = int(rng.choice(reply['legal'])) \
+            if reply.get('to_move') and reply.get('legal') else None
+        reply = gw._op_play({'sid': sid, 'action': action})
+        assert 'error' not in reply, reply
+        plies += 1
+    return reply['outcome'], plies
+
+
+# ---------------------------------------------------------------------------
+# deterministic session primitives
+
+
+def test_state_digest_order_insensitive_and_value_sensitive():
+    """The journal's hidden digest keys on CONTENT: dict insertion order
+    must not matter (seats are cached in play order, replayed in sorted
+    order), while any value or structure change must."""
+    from handyrl_tpu.serving.gateway import state_digest
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    d1 = state_digest({0: a, 1: (a * 2, None)})
+    d2 = state_digest({1: (a * 2, None), 0: a.copy()})
+    assert d1 == d2
+    assert state_digest({0: a, 1: (a * 2 + 1, None)}) != d1
+    assert state_digest({0: a}) != d1
+    assert state_digest({}) == state_digest({})
+    assert state_digest(None) != state_digest({})
+
+
+def test_session_env_seed_audited_and_distinct_per_counter():
+    """Env construction seeds are a pure function of (base_seed, session
+    counter) — the journal replay rebuilds the identical env — and
+    distinct sessions draw distinct seeds."""
+    from handyrl_tpu.serving.gateway import session_env_seed
+    assert session_env_seed(11, 1) == session_env_seed(11, 1)
+    seeds = {session_env_seed(11, c) for c in range(1, 33)}
+    assert len(seeds) == 32
+    assert session_env_seed(12, 1) != session_env_seed(11, 1)
+
+
+# ---------------------------------------------------------------------------
+# SessionLedger (fault.py): the session-affinity book
+
+
+def test_session_ledger_affinity_round_trip():
+    from handyrl_tpu.fault import SessionLedger
+    led = SessionLedger(clock=lambda: 0.0)
+    led.book('s1', 'r0')
+    led.book('s2', 'r0')
+    led.book('s3', 'r1')
+    assert led.replica_of('s1') == 'r0'
+    assert led.sessions_on('r0') == ['s1', 's2']
+    assert led.outstanding() == 3
+    assert led.outstanding_by_replica() == {'r0': 2, 'r1': 1}
+    # handoff re-pin returns the previous owner
+    assert led.move('s2', 'r1') == 'r0'
+    assert led.sessions_on('r1') == ['s2', 's3']
+    assert led.move('s2', 'r1') == 'r1'   # idempotent re-pin
+    assert led.release('s1') and not led.release('s1')
+    assert led.stats['booked'] == 3
+    assert led.stats['moved'] == 1
+    assert led.stats['released'] == 1
+
+
+def test_session_ledger_fail_replica_strands_and_journals():
+    from handyrl_tpu.fault import SessionLedger
+    led = SessionLedger(clock=lambda: 42.0)
+    led.book('s1', 'r0')
+    led.book('s2', 'r0')
+    led.book('s3', 'r1')
+    sids = led.fail_replica('r0', reason='killed')
+    assert sids == ['s1', 's2']
+    assert led.outstanding() == 1 and led.replica_of('s1') is None
+    assert led.fail_replica('r0') == []       # already empty: no double count
+    assert led.stats['stranded'] == 2
+    assert led.stats['replica_failures'] == 1
+    events = led.drain_stranding_events()
+    assert [(s, r, why) for s, r, why, _t in events] == \
+        [('s1', 'r0', 'killed'), ('s2', 'r0', 'killed')]
+    assert all(t == 42.0 for _s, _r, _w, t in events)
+    assert led.drain_stranding_events() == []  # consumed
+
+
+# ---------------------------------------------------------------------------
+# ChaosProxy flap: the bouncing-link fault mode
+
+
+@pytest.mark.timeout(60)
+def test_chaos_proxy_flap_bounces_and_restores():
+    """``flap(period)`` must repeatedly sever live connections and refuse
+    new ones for half a period, then restore — and ``stop_flap`` must
+    leave the link usable (the deterministic driver for mid-match
+    failover tests)."""
+    from tests.proxy import ChaosProxy
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(('127.0.0.1', 0))
+    lsock.listen(16)
+
+    def echo_loop():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+
+            def serve(c):
+                try:
+                    while True:
+                        data = c.recv(1 << 12)
+                        if not data:
+                            break
+                        c.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+
+            threading.Thread(target=serve, args=(conn,),
+                             name='flap-echo', daemon=True).start()
+
+    threading.Thread(target=echo_loop, name='flap-echo-accept',
+                     daemon=True).start()
+    proxy = ChaosProxy(target_port=lsock.getsockname()[1])
+
+    def round_trip(payload):
+        with socket.create_connection(('127.0.0.1', proxy.port),
+                                      timeout=5) as c:
+            c.settimeout(5)
+            c.sendall(payload)
+            return c.recv(1 << 12)
+
+    try:
+        assert round_trip(b'before') == b'before'
+        # hold a connection open across the first bounce: the flap must
+        # hard-sever it (EOF/RST at the client)
+        held = socket.create_connection(('127.0.0.1', proxy.port),
+                                        timeout=5)
+        held.settimeout(10)
+        held.sendall(b'ping')
+        assert held.recv(1 << 12) == b'ping'
+        proxy.flap(0.1)
+        try:
+            assert held.recv(1 << 12) == b''
+        except OSError:
+            pass                      # RST instead of EOF: equally severed
+        finally:
+            held.close()
+        deadline = time.monotonic() + 30
+        while proxy.flaps < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert proxy.flaps >= 3, 'link never bounced'
+        proxy.stop_flap()
+        assert proxy.accepting
+        assert round_trip(b'after') == b'after'   # restored, not wedged
+    finally:
+        proxy.close()
+        lsock.close()
+
+
+# ---------------------------------------------------------------------------
+# the gateway end to end (in-process fleet, direct op calls)
+
+
+@pytest.mark.timeout(300)
+def test_gateway_lifecycle_outcome_and_rating_booked(tmp_path):
+    """Open -> play-to-terminal -> outcome booked: the env lives host-side,
+    opponent seats act through the fleet, every step lands in the journal,
+    and the finished match books a provisional ``gateway:<client>`` entry
+    against the rated pinned model in the on-disk RatingBook."""
+    from handyrl_tpu.serving.gateway import MatchGateway
+    resolver, services, _w = _in_process_fleet(tmp_path)
+    gw = MatchGateway(_gw_args(tmp_path, resolver.port))
+    try:
+        reply = gw._op_open({'env': 'TicTacToe', 'seat': 0,
+                             'client': 'alice', 'seed': 23})
+        assert 'error' not in reply, reply
+        sid = reply['sid']
+        assert reply['model'] == 'default@1'   # floating selector pinned
+        assert reply['to_move'] and reply['legal']
+        rng = random.Random(0)
+        reply = gw._op_play({'sid': sid,
+                             'action': int(rng.choice(reply['legal']))})
+        assert 'error' not in reply, reply
+        # the opponent seat just acted through the fleet: session booked
+        assert gw.ledger.replica_of(sid) is not None
+        outcome, plies = _play_to_outcome(gw, sid, reply, rng)
+        assert plies >= 2
+        assert set(outcome) == {0, 1}
+        assert sum(outcome.values()) == pytest.approx(0.0)   # zero-sum
+        # the session retired itself and booked the match
+        assert sid not in gw._sessions and gw.ledger.outstanding() == 0
+        session = gw._op_play({'sid': sid})
+        assert 'error' in session                  # unknown after finish
+        assert gw.ratings.is_provisional('gateway:alice')
+        assert not gw.ratings.is_provisional('default@1')
+        assert gw.ratings.games('gateway:alice') == 1
+        # outcomes round-trip through the journal on disk
+        book = make_rating_book({})
+        assert book.load(journal_path(str(tmp_path)))
+        assert book.is_provisional('gateway:alice')
+        assert 'default@1' in book.names()
+    finally:
+        _gateway_close(gw)
+        for svc in services:
+            svc.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_gateway_sheds_opens_and_rejects_bad_plays(tmp_path):
+    """Admission control sheds OPENS past max_sessions (never plies on
+    admitted sessions), and the play protocol rejects illegal actions,
+    off-turn actions, and missing actions with typed errors."""
+    from handyrl_tpu.serving.gateway import MatchGateway
+    resolver, services, _w = _in_process_fleet(tmp_path)
+    gw = MatchGateway(_gw_args(tmp_path, resolver.port, max_sessions=1))
+    try:
+        r1 = gw._op_open({'env': 'TicTacToe', 'seat': 0, 'client': 'a',
+                          'seed': 5})
+        assert 'error' not in r1, r1
+        r2 = gw._op_open({'env': 'TicTacToe', 'seat': 0, 'client': 'b'})
+        assert r2.get('shed') and 'error' in r2
+        # protocol errors never kill the admitted session
+        assert 'error' in gw._op_play({'sid': r1['sid'], 'action': 99})
+        assert 'error' in gw._op_play({'sid': r1['sid']})   # turn, no action
+        assert 'error' in gw._op_play({'sid': 'zzz', 'action': 0})
+        good = gw._op_play({'sid': r1['sid'], 'action': r1['legal'][0]})
+        assert 'error' not in good, good          # the ply still lands
+        closed = gw._op_close({'sid': r1['sid']})
+        assert closed['closed'] and gw.ledger.outstanding() == 0
+        # bad opens error without shedding once a slot is free
+        bad_env = gw._op_open({'env': 'NoSuchGame'})
+        assert 'error' in bad_env and not bad_env.get('shed')
+        bad_seat = gw._op_open({'env': 'TicTacToe', 'seat': 5})
+        assert 'error' in bad_seat and not bad_seat.get('shed')
+        # the shed slot freed: a fresh open is admitted again
+        r3 = gw._op_open({'env': 'TicTacToe', 'seat': 0, 'client': 'c'})
+        assert 'error' not in r3, r3
+    finally:
+        _gateway_close(gw)
+        for svc in services:
+            svc.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_gateway_reconstruct_replays_journal_byte_identical(tmp_path):
+    """The journal alone carries the match: ``_reconstruct`` rebuilds a
+    session from (env, seed, actions) through the fleet, verifying the
+    replayed opponent actions and the rebuilt hidden digest before
+    adopting — and the adopted state plays on to the identical outcome.
+    A tampered journal digest must be refused (mismatch + drop), never
+    silently adopted."""
+    from handyrl_tpu.serving.gateway import MatchGateway, state_digest
+    resolver, services, _w = _in_process_fleet(tmp_path)
+    gw = MatchGateway(_gw_args(tmp_path, resolver.port))
+    try:
+        rng = random.Random(3)
+        reply = gw._op_open({'env': 'TicTacToe', 'seat': 0,
+                             'client': 'rec', 'seed': 31})
+        sid = reply['sid']
+        for _ in range(2):
+            reply = gw._op_play({'sid': sid,
+                                 'action': int(rng.choice(reply['legal']))})
+            assert 'error' not in reply, reply
+        session = gw._sessions[sid]
+        obs_before = np.asarray(session.env.observation(0)).copy()
+        digest_before = session.journal['hidden_digest']
+        draws_before = session.draws
+        env_before = session.env
+        assert gw._reconstruct(session, gw._router())
+        assert session.env is not env_before       # a REBUILT env adopted
+        np.testing.assert_array_equal(
+            np.asarray(session.env.observation(0)), obs_before)
+        assert state_digest(session.hiddens) == digest_before
+        assert session.draws == draws_before       # seed cursor realigned
+        # the adopted state is live: play on (from the byte-identical
+        # pre-reconstruct reply) to a terminal outcome
+        outcome, _ = _play_to_outcome(gw, sid, reply, rng)
+        assert sum(outcome.values()) == pytest.approx(0.0)
+
+        # tampered journal: digest divergence drops, never adopts
+        reply = gw._op_open({'env': 'TicTacToe', 'seat': 0,
+                             'client': 'tamper', 'seed': 37})
+        sid2 = reply['sid']
+        reply = gw._op_play({'sid': sid2,
+                             'action': int(rng.choice(reply['legal']))})
+        assert 'error' not in reply, reply
+        session2 = gw._sessions[sid2]
+        session2.journal['hidden_digest'] = '0' * 40
+        assert not gw._reconstruct(session2, gw._router())
+        assert sid2 not in gw._sessions            # dropped, not adopted
+    finally:
+        _gateway_close(gw)
+        for svc in services:
+            svc.stop(drain=False)
+        resolver.stop(drain=False)

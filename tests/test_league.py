@@ -200,6 +200,74 @@ def test_journal_load_missing_or_torn(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# provisional members: the gateway's external players
+
+
+def test_provisional_member_rating_flow():
+    """``seed_provisional`` creates an unrated outsider at the learner's
+    current rating; ``record_between`` moves BOTH sides' Elo but books
+    the learner-relative PFSP (games, wins) statistics only on the
+    provisional side — a rated member's PFSP curve is never polluted by
+    third-party matches — and the promotion denominator never moves."""
+    book = league.RatingBook(track_sigma=False, k_factor=32.0)
+    book.seed('default@1', 1300.0)
+    book.entry(league.LEARNER)['rating'] = 1250.0
+    e = book.seed_provisional('gateway:alice')
+    assert book.is_provisional('gateway:alice')
+    assert e['rating'] == pytest.approx(1250.0)      # learner-seeded
+    assert book.seed_provisional('gateway:alice') is e   # idempotent
+    assert not book.is_provisional('default@1')
+    assert not book.is_provisional('nobody')
+
+    before = book.games_since_promote
+    book.record_between('gateway:alice', 'default@1', 1.0)   # upset win
+    assert book.rating('gateway:alice') > 1250.0
+    assert book.rating('default@1') < 1300.0
+    assert book.games('gateway:alice') == 1
+    assert book.win_rate('gateway:alice') == pytest.approx(1.0)
+    assert book.games('default@1') == 0              # rated side untouched
+    assert book.games_since_promote == before        # gate never fed
+    # the mirrored loss books on the provisional side as its own score
+    book.record_between('default@1', 'gateway:alice', 1.0)
+    assert book.games('gateway:alice') == 2
+    assert book.win_rate('gateway:alice') == pytest.approx(0.5)
+
+
+def test_provisional_flag_survives_journal_round_trip(tmp_path):
+    path = str(tmp_path / 'ratings.json')
+    book = league.RatingBook()
+    book.seed_provisional('gateway:bob', rating=1111.0)
+    book.record_between('gateway:bob', 'default@1', 0.0)
+    book.save(path)
+    clone = league.RatingBook()
+    assert clone.load(path)
+    assert clone.is_provisional('gateway:bob')
+    assert not clone.is_provisional('default@1')
+    assert clone.rating('gateway:bob') == book.rating('gateway:bob')
+    assert clone.to_state() == book.to_state()
+
+
+def test_provisional_games_never_feed_promotion_gate(tmp_path):
+    """Neither ``record_between`` third-party games nor learner games
+    against a provisional opponent count toward ``min_games`` — only
+    learner-vs-league games can promote a champion."""
+    pool, _ = _pool_with_versions(tmp_path, [1, 2], promote_margin=0.0,
+                                  min_games=2)
+    book = league.RatingBook()
+    book.seed_provisional('gateway:bob')
+    book.entry(league.LEARNER)['rating'] = 2000.0    # miles past margin
+    book.record('gateway:bob', 1.0)                  # learner vs outsider
+    book.record_between('gateway:bob', 'default@1', 1.0)
+    assert book.games_since_promote == 0
+    assert not pool.should_promote(book)             # 0 of 2 gate games
+    book.record('default@1', 1.0)
+    book.record('random', 1.0)
+    book.entry(league.LEARNER)['rating'] = 2000.0
+    assert book.games_since_promote == 2
+    assert pool.should_promote(book)
+
+
+# ---------------------------------------------------------------------------
 # the promotion gate
 
 

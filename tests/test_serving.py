@@ -899,6 +899,87 @@ def test_fleet_rolling_promote_warms_before_flip(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_routed_client_pin_replays_byte_identical_across_promote(tmp_path):
+    """Version pinning across a champion flip: requests dispatched against
+    the floating ``@champion`` selector pin to the concrete version they
+    resolved to at submit time, so a burst stranded by a replica death
+    AFTER the champion flips still replays against the OLD version on the
+    survivor — byte-identical to the old champion's replies, never the new
+    one's — while fresh floating requests follow the flip (and a rolling
+    ``promote`` clears the pin cache)."""
+    from handyrl_tpu.serving.fleet import RoutedClient, ServiceResolver
+    from tests.proxy import ChaosProxy
+    env, w1 = _ttt_wrapper(seed=7)
+    _, w2 = _ttt_wrapper(seed=19)
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    reg = ModelRegistry(str(tmp_path))
+    reg.publish('default', snapshot=w1.snapshot(), version=1, promote=True)
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_timeout=60.0)).start()
+    svc_a = InferenceService(_service_args(str(tmp_path))).start()
+    svc_b = InferenceService(_service_args(str(tmp_path))).start()
+    proxy = ChaosProxy(target_port=svc_a.port)     # a dies through this
+    admin = ServiceClient('127.0.0.1', resolver.port, name='ops')
+    admin._call_admin({'op': 'register', 'replica': 'a',
+                       'endpoint': '127.0.0.1:%d' % proxy.port, 'pid': 0})
+    admin._call_admin({'op': 'register', 'replica': 'b',
+                       'endpoint': '127.0.0.1:%d' % svc_b.port, 'pid': 0})
+    rc = RoutedClient('127.0.0.1', resolver.port, timeout=15.0,
+                      refresh_interval=0.2)
+    try:
+        seeds = [sample_seed(11, (0, k), 0) for k in range(4)]
+        refs1 = [model_act(w1, obs, None, legal, s) for s in seeds]
+        refs2 = [model_act(w2, obs, None, legal, s) for s in seeds]
+        # the two champions must be distinguishable or the test is void
+        assert any(r1['prob'] != r2['prob']
+                   for r1, r2 in zip(refs1, refs2))
+        rep = rc.request('default@champion', obs, legal=legal,
+                         seed=seeds[0])
+        assert rep['prob'] == refs1[0]['prob']       # pinned default@1
+        # strand a whole burst on replica a: the stall swallows replies
+        # (requests ARRIVE, answers never come), so every rid must replay
+        proxy.stall = True
+        rids = [rc.submit('default@champion', obs, legal=legal, seed=s,
+                          replica='a') for s in seeds]
+        accepted = proxy.accepted
+        assert accepted > 0, 'burst never dialed replica a'
+        # the champion flips UNDER the stranded burst
+        reg.publish('default', snapshot=w2.snapshot(), version=2,
+                    promote=True)
+        assert reg.resolve('default', 'champion')[0] == '2'
+        time.sleep(0.3)          # outlive the pin cache TTL: the replay
+        proxy.accepting = False  # must use the per-request pin, not a
+        proxy.sever()            # conveniently-cached resolution
+        for rid, ref in zip(rids, refs1):
+            rep = rc.collect(rid)           # replays ride replica b
+            assert rep['action'] == ref['action']
+            assert rep['prob'] == ref['prob'], \
+                'stranded request followed the champion flip'
+        # fresh floating requests re-pin to the NEW champion
+        for s, ref in zip(seeds, refs2):
+            rep = rc.request('default@champion', obs, legal=legal, seed=s)
+            assert rep['prob'] == ref['prob']
+        # and the real rolling promote still walks a restored fleet
+        # (warms both replicas, clears the pin cache)
+        proxy.stall = False
+        proxy.accepting = True
+        out = rc.promote('default@2', timeout=120)
+        assert out.get('ok'), out
+        assert sorted(out['warmed']) == ['a', 'b']
+        rep = rc.request('default@champion', obs, legal=legal,
+                         seed=seeds[0])
+        assert rep['prob'] == refs2[0]['prob']
+    finally:
+        rc.close()
+        admin.close()
+        proxy.close()
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
 def test_engine_client_rotates_across_replica_endpoints(tmp_path):
     """The worker EngineClient with a comma-separated endpoint list stays
     on the ENGINE path when one replica dies: the dead endpoint down-marks
