@@ -1189,6 +1189,42 @@ def run_serve(probe: dict):
         fill = (status.get('engine_requests', 0)
                 / max(1, status.get('engine_batches', 1)))
 
+        # tracing-off vs tracing-on(rate 0.1) adjacent A/B pair (the PR 7
+        # ingest-pair shape): the 'trace' admin op flips the SAME warmed
+        # service process between legs, alternating best-of-3 per side —
+        # the serving-path span cost is below one-shot run-to-run noise
+        from handyrl_tpu import telemetry as _tel
+        trace_rate = float(os.environ.get('BENCH_TRACE_RATE', '0.1'))
+        trace_dir_t = tempfile.mkdtemp(prefix='bench_serve_trace.')
+        tr_rounds = []
+        try:
+            for i in range(3):
+                status_client.call_admin({'op': 'trace', 'dir': trace_dir_t,
+                                          'rate': trace_rate}, timeout=30)
+                _tel.configure_tracing(trace_dir_t, trace_rate, force=True)
+                on_rps, _lt, _et = _serve_client_load(
+                    'localhost', port, model, obs, legal, n_clients, 0,
+                    requests, base_seed=51 + i)
+                status_client.call_admin({'op': 'trace', 'dir': '',
+                                          'rate': None}, timeout=30)
+                _tel.configure_tracing('', None, force=True)
+                off_rps, _lt, _et = _serve_client_load(
+                    'localhost', port, model, obs, legal, n_clients, 0,
+                    requests, base_seed=61 + i)
+                tr_rounds.append((on_rps, off_rps))
+        finally:
+            try:
+                status_client.call_admin({'op': 'trace', 'dir': '',
+                                          'rate': None}, timeout=30)
+            except Exception:   # noqa: BLE001 — best-effort reset
+                pass
+            _tel.configure_tracing('', None, force=True)
+            shutil.rmtree(trace_dir_t, ignore_errors=True)
+        tracing_on_rps = max(on for on, _ in tr_rounds)
+        tracing_off_rps = max(off for _, off in tr_rounds)
+        tracing_overhead = (100.0 * (1.0 - tracing_on_rps / tracing_off_rps)
+                            if tracing_off_rps else 0.0)
+
         # measured graceful drain: every in-flight request through the
         # SIGTERM must be ANSWERED (ok or an explicit drain error), and the
         # service must exit 75 (the PreemptionGuard supervisor contract)
@@ -1239,6 +1275,10 @@ def run_serve(probe: dict):
              drain_unanswered=unanswered,
              drain_seconds=round(drain_seconds, 2),
              drain_exit_code=exit_code,
+             tracing_on_requests_per_sec=round(tracing_on_rps, 2),
+             tracing_off_requests_per_sec=round(tracing_off_rps, 2),
+             tracing_overhead_pct=round(tracing_overhead, 2),
+             trace_sample_rate=trace_rate,
              **fleet_keys,
              vs_baseline_def=('%d-client req/s over single-client req/s '
                               'against the same service — the continuous-'
@@ -1379,6 +1419,69 @@ def run_gateway(probe: dict):
         status_cl = GatewayClient('localhost', gport, timeout=30.0,
                                   name='bstatus')
         status = status_cl.status()
+
+        # tracing-off vs tracing-on(rate 0.1) adjacent A/B pair (the PR 7
+        # ingest-pair shape): the 'trace' admin op flips the SAME warmed
+        # gateway + every replica between legs, alternating best-of-3 per
+        # side on the sequential single-session match rate
+        from handyrl_tpu import telemetry as _tel
+        from handyrl_tpu.serving.client import (ServiceClient,
+                                                parse_endpoint)
+        trace_rate = float(os.environ.get('BENCH_TRACE_RATE', '0.1'))
+        trace_dir_t = tempfile.mkdtemp(prefix='bench_gateway_trace.')
+
+        def toggle_tracing(dirpath, rate):
+            status_cl._call({'op': 'trace', 'dir': dirpath, 'rate': rate})
+            for row in rc.replicas():
+                try:
+                    host, rport = parse_endpoint(row['endpoint'])
+                    sc = ServiceClient(host, rport, timeout=30.0,
+                                       name='btrace', dial_retries=1)
+                    try:
+                        sc.call_admin({'op': 'trace', 'dir': dirpath,
+                                       'rate': rate}, timeout=30)
+                    finally:
+                        sc.close()
+                except Exception:  # noqa: BLE001 — a corpse mid-respawn
+                    pass
+            _tel.configure_tracing(dirpath, rate, force=True)
+
+        tr_rounds = []
+        # a TicTacToe match is ~10-20ms here, so a 2-match leg is pure
+        # scheduler noise — each measured leg needs enough matches that
+        # the rate estimate is dominated by ply work, not jitter
+        ab_matches = max(10, matches)
+        ab_rounds = int(os.environ.get('BENCH_TRACE_ROUNDS', '5'))
+        try:
+            # one unmeasured leg first — the replica respawned after the
+            # SIGKILL recompiles its engine on first touch, and that cost
+            # must not land in either side of the pair — then alternate
+            # which side goes first per round so settling drift cancels
+            play_matches(99, ab_matches, collect=False)
+            for i in range(ab_rounds):
+                legs = {}
+                order = ('on', 'off') if i % 2 == 0 else ('off', 'on')
+                for leg in order:
+                    if leg == 'on':
+                        toggle_tracing(trace_dir_t, trace_rate)
+                    else:
+                        toggle_tracing('', None)
+                    t1 = time.monotonic()
+                    d = play_matches((100 if leg == 'on' else 200) + i,
+                                     ab_matches, collect=False)
+                    legs[leg] = d / max(time.monotonic() - t1, 1e-9)
+                tr_rounds.append((legs['on'], legs['off']))
+        finally:
+            try:
+                toggle_tracing('', None)
+            except Exception:   # noqa: BLE001 — best-effort reset
+                pass
+            shutil.rmtree(trace_dir_t, ignore_errors=True)
+        tracing_on_rate = max(on for on, _ in tr_rounds)
+        tracing_off_rate = max(off for _, off in tr_rounds)
+        tracing_overhead = (100.0 * (1.0 - tracing_on_rate
+                                     / tracing_off_rate)
+                            if tracing_off_rate else 0.0)
         status_cl.close()
 
         # gateway SIGTERM drains to exit 75 (the supervisor contract),
@@ -1419,6 +1522,10 @@ def run_gateway(probe: dict):
              shed_total=int(status.get('shed', 0)),
              outcomes_recorded=int(status.get('outcomes', 0)),
              client_errors=errors[0],
+             tracing_on_matches_per_sec=round(tracing_on_rate, 2),
+             tracing_off_matches_per_sec=round(tracing_off_rate, 2),
+             tracing_overhead_pct=round(tracing_overhead, 2),
+             trace_sample_rate=trace_rate,
              gateway_drain_exit_code=gw_exit,
              fleet_drain_exit_code=fleet_exit,
              vs_baseline_def=('%d-session matches/s over single-session '

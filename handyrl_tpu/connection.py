@@ -311,6 +311,12 @@ HEARTBEAT_KIND = '__hb__'
 # (inference.EngineClient.rpc does exactly that, via ``is_infer``).
 INFER_KIND = '__infer__'
 
+# Serving-path trace context rides INSIDE the INFER/admin body dict under
+# this key (docs/observability.md, "Serving-path tracing"): extra dict keys
+# are ignored by peers that predate it, so absent context simply means
+# "unsampled" — no wire-format break, old and new peers interoperate.
+TRACE_KEY = 'trace'
+
 
 def is_heartbeat(msg) -> bool:
     return (isinstance(msg, (list, tuple)) and len(msg) == 2

@@ -916,7 +916,26 @@ class InferenceEngine:
         # rate-sampled batch-level span in the episode trace (plus the
         # stage_seconds{stage=engine_batch} histogram): one span per
         # coalesced forward batch, sized for the critical-path report
-        with telemetry.trace_span('engine_batch', rows=len(group), mid=mid):
+        extra: Dict[str, Any] = {}
+        if telemetry.trace_enabled():
+            # serving-path context: per-request queue_wait spans (intake ->
+            # batch start) for every sampled trace id, and the batch span
+            # carries args.trace_ids so --serve chains link through it
+            # (same linkage shape as train_step's episode list)
+            now_mono, now_wall = time.monotonic(), time.time()
+            tids = []
+            for _ep, req, t_arr in group:
+                tid = req.get('trace')
+                if not (tid and telemetry.trace_sampled(tid)):
+                    continue
+                tids.append(tid)
+                wait = max(0.0, now_mono - t_arr)
+                telemetry.trace_event('queue_wait', ts=now_wall - wait,
+                                      dur=wait, trace_id=tid, mid=mid)
+            if tids:
+                extra = {'trace_ids': tids, 'always': True}
+        with telemetry.trace_span('engine_batch', rows=len(group), mid=mid,
+                                  **extra):
             self._serve_group(mid, group)
 
     def _serve_group(self, mid: int, group: List[tuple]):

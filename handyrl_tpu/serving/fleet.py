@@ -53,7 +53,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import telemetry
-from ..connection import (FramedConnection, Hub, INFER_KIND,
+from ..connection import (FramedConnection, Hub, INFER_KIND, TRACE_KEY,
                           open_socket_connection, is_infer)
 from ..fault import (Backoff, FleetController, HOST_DEGRADED, HOST_HEALTHY)
 from ..guard import PREEMPT_EXIT_CODE, PreemptionGuard
@@ -676,11 +676,22 @@ class ServiceResolver:
         states, the routable count, and the fleet-level alert state."""
         with self._lock:
             states = {n: self.controller.state(n) for n in self._replicas}
+            slos = {n: dict(r.get('slo') or {})
+                    for n, r in self._replicas.items()}
         info: Dict[str, Any] = {
             'fleet_replicas': states,
             'progress': {'replicas': len(states),
                          'routable': sum(1 for s in states.values()
                                          if s in _ROUTABLE)},
+            # live per-replica request table (main.py --status renders it)
+            'requests': [{'replica': n,
+                          'inflight': int(slos[n].get('inflight', 0)),
+                          'p50_ms': float(slos[n].get('p50_ms', 0.0)),
+                          'p99_ms': float(slos[n].get('p99_ms', 0.0)),
+                          'received': int(slos[n].get('received', 0)),
+                          'answered': int(slos[n].get('answered', 0)),
+                          'draining': bool(slos[n].get('draining'))}
+                         for n in sorted(slos)],
         }
         if self._alerts is not None:
             info['alerts'] = self._alerts.maybe_evaluate(
@@ -898,6 +909,8 @@ class RoutedClient:
         a session-affine replica to the front of the candidate order when
         it is still routable (gateway affinity — never a hard pin)."""
         last: Optional[BaseException] = None
+        trace = req.get(TRACE_KEY)
+        t0 = time.time()
         for _attempt in range(2):
             names = self._candidates()
             if prefer is not None and prefer in names:
@@ -915,6 +928,11 @@ class RoutedClient:
                     continue
                 self._m_requests(name).inc()
                 self.last_replica = name
+                if trace:
+                    telemetry.trace_event('route_dispatch', ts=t0,
+                                          dur=time.time() - t0,
+                                          trace_id=trace, replica=name,
+                                          breaker=breaker.state)
                 return name, sub
             self._refresh(force=True)
         raise ServiceUnavailable(
@@ -924,10 +942,16 @@ class RoutedClient:
     # -- the ServiceClient surface -----------------------------------------
 
     def submit(self, model: str, obs, hidden=None, legal=None,
-               seed=None, replica: Optional[str] = None) -> int:
+               seed=None, replica: Optional[str] = None, trace=None) -> int:
         self._refresh()
+        if trace is None and telemetry.trace_enabled():
+            trace = telemetry.mint_trace_id()
         req = {'model': self._pin_spec(model), 'obs': obs, 'hidden': hidden,
                'legal': legal, 'seed': seed}
+        if trace:
+            # booked in the replay request itself, so a failover replay
+            # dispatches with — and links to — the ORIGINAL trace id
+            req[TRACE_KEY] = trace
         name, sub = self._dispatch(req, prefer=replica)
         with self._lock:
             self._rid += 1
@@ -960,8 +984,16 @@ class RoutedClient:
         # reply from another replica is byte-identical
         attempts = max(2, len(self.replicas()) + 1)
         for _attempt in range(attempts):
+            t_replay = time.time()
             name2, sub2 = self._dispatch(req)
             self._m_replays.inc()
+            if req.get(TRACE_KEY):
+                # link span: the replay carries the ORIGINAL trace id, so
+                # the SIGKILL reads as one causal chain in the trace
+                telemetry.trace_event('router_replay', ts=t_replay,
+                                      dur=time.time() - t_replay,
+                                      trace_id=req[TRACE_KEY], link='replay',
+                                      from_replica=name, to_replica=name2)
             try:
                 reply = self._client(name2).collect(sub2, timeout=timeout)
                 self._ok(name2)
@@ -980,10 +1012,10 @@ class RoutedClient:
 
     def request(self, model: str, obs, hidden=None, legal=None, seed=None,
                 timeout: Optional[float] = None,
-                replica: Optional[str] = None) -> Dict[str, Any]:
+                replica: Optional[str] = None, trace=None) -> Dict[str, Any]:
         return self.collect(self.submit(model, obs, hidden=hidden,
                                         legal=legal, seed=seed,
-                                        replica=replica),
+                                        replica=replica, trace=trace),
                             timeout=timeout)
 
     def status(self, timeout: Optional[float] = None) -> Dict[str, Any]:

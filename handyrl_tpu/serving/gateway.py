@@ -57,7 +57,7 @@ import numpy as np
 
 from .. import telemetry
 from ..connection import FramedConnection, Hub
-from ..connection import open_socket_connection
+from ..connection import TRACE_KEY, open_socket_connection
 from ..environment import make_env
 from ..fault import HOST_DEGRADED, HOST_HEALTHY, SessionLedger
 from ..generation import sample_seed
@@ -128,7 +128,7 @@ class MatchSession:
 
     def __init__(self, sid: str, counter: int, env_name: str,
                  env_args: Dict[str, Any], env, model: str, seat: int,
-                 base_seed: int, client: str, clock=time.time):
+                 base_seed: int, client: str, clock=time.time, trace=None):
         self.sid = sid
         self.counter = int(counter)
         self.env = env
@@ -141,6 +141,11 @@ class MatchSession:
         self.lock = threading.Lock()
         self.hiddens: Dict[int, Any] = {}   # opponent seat -> cached hidden
         self.draws = 1                       # draw 0 built the env seed
+        # the session's trace context: the id minted (or adopted) at open;
+        # reconstruct/handoff link spans carry it so a failover reads as
+        # one causal chain from the original open
+        self.trace = trace
+        self.lat_ring: deque = deque(maxlen=64)   # per-session ply seconds
         self.done = False
         self.outcome: Optional[Dict[int, float]] = None
         self.journal: Dict[str, Any] = {
@@ -159,6 +164,9 @@ class MatchSession:
         return {'sid': self.sid, 'env': self.journal['env'],
                 'model': self.model, 'seat': self.seat,
                 'client': self.client, 'plies': self.plies(),
+                'version': self.model.rpartition('@')[2] or None,
+                'ply_p99_ms': (ring_percentile_ms(list(self.lat_ring), 0.99)
+                               if self.lat_ring else None),
                 'age_s': round(clock() - self.opened_at, 3),
                 'replica': replica, 'done': self.done}
 
@@ -244,6 +252,7 @@ class MatchGateway:
         if self.metrics_port and telemetry.enabled():
             self._exporter = telemetry.TelemetryExporter(
                 lambda: [telemetry.snapshot()], port=self.metrics_port,
+                status=self._status_info,
             ).start()
             self.metrics_port = self._exporter.port
         loops = [(self._accept_loop, 'gateway-accept'),
@@ -322,6 +331,17 @@ class MatchGateway:
                 elif op == 'sessions':
                     self.hub.send(ep, (SERVE_KIND,
                                        {'sessions': self.session_table()}))
+                elif op == 'trace':
+                    # runtime tracing toggle (bench A/B legs flip the
+                    # SAME warmed gateway on and off between legs)
+                    telemetry.configure_tracing(
+                        str(body.get('dir') or ''), body.get('rate'),
+                        force=True)
+                    self.hub.send(ep, (SERVE_KIND,
+                                       {'ok': True,
+                                        'dir': telemetry.trace_dir(),
+                                        'rate':
+                                            telemetry.trace_sample_rate()}))
                 elif op in ('open', 'play', 'close'):
                     self._queue.put((ep, body))
                 else:
@@ -386,15 +406,24 @@ class MatchGateway:
         router = self._router()
         pinned = router._pin_spec(model)
         sid = 's%06d' % counter
+        # session trace context: adopt the client's id, else mint at this
+        # edge; every ply/seat/reconstruct span of the session links to it
+        tid = body.get(TRACE_KEY) or (telemetry.mint_trace_id()
+                                      if telemetry.trace_enabled() else None)
+        t0 = time.time()
         session = MatchSession(sid, counter, env_name, env_args, env,
-                               pinned, seat, base_seed, client)
+                               pinned, seat, base_seed, client, trace=tid)
         with self._lock:
             self._sessions[sid] = session
         with session.lock:
-            self._advance(session, None, router)
+            self._advance(session, None, router, trace=tid)
             if router.last_replica is not None:
                 self.ledger.book(sid, router.last_replica)
             reply = self._state_reply(session)
+        if tid:
+            telemetry.trace_event('gateway_open', ts=t0,
+                                  dur=time.time() - t0, trace_id=tid,
+                                  sid=sid, model=pinned, client=client)
         self._m_opened.inc()
         self._set_gauges()
         reply.update({'sid': sid, 'seat': seat, 'model': pinned})
@@ -409,7 +438,12 @@ class MatchGateway:
         if session is None:
             return {'error': 'unknown session %r' % sid}
         router = self._router()
+        # per-ply trace context: the client's id if it sent one, else a
+        # fresh mint; args carry the session's open-time id as the link
+        tid = body.get(TRACE_KEY) or (telemetry.mint_trace_id()
+                                      if telemetry.trace_enabled() else None)
         t0 = time.monotonic()
+        t0_wall = time.time()
         with session.lock:
             if session.done:
                 return dict(self._state_reply(session), sid=sid)
@@ -429,17 +463,22 @@ class MatchGateway:
             # action None here = a spectate poll (the client's seat is out
             # of the match but the game runs on): advance to terminal
             before = session.plies()
-            self._advance(session, action, router)
+            self._advance(session, action, router, trace=tid)
             played = session.journal['actions'][before:]
             if router.last_replica is not None:
                 self.ledger.move(sid, router.last_replica)
             session.last_active = time.time()
+            session.lat_ring.append(time.monotonic() - t0)
             reply = self._state_reply(session)
         dt = time.monotonic() - t0
         with self._lock:
             self._lat_ring.append(dt)
         self._m_plies.inc()
         self._m_ply_h.observe(dt)
+        if tid:
+            telemetry.trace_event('gateway_ply', ts=t0_wall, dur=dt,
+                                  trace_id=tid, sid=sid,
+                                  session_trace=session.trace)
         reply.update({'sid': sid,
                       'actions': [{int(p): int(a) for p, a in step.items()}
                                   for step in played]})
@@ -479,7 +518,7 @@ class MatchGateway:
 
     def _advance(self, session: MatchSession, action: Optional[int],
                  router: RoutedClient,
-                 replica: Optional[str] = None) -> None:
+                 replica: Optional[str] = None, trace=None) -> None:
         """Step the env until it is the client's turn with no pending
         action, or terminal. Every step's action dict lands in the
         journal; opponent seats act (and observers watch) through the
@@ -498,10 +537,11 @@ class MatchGateway:
                     action = None
                 else:
                     moves[p] = self._opponent_act(session, p, router,
-                                                  replica)
+                                                  replica, trace)
             for p in watching:
                 if p != session.seat:
-                    self._opponent_watch(session, p, router, replica)
+                    self._opponent_watch(session, p, router, replica,
+                                         trace)
             env.step(moves)
             session.journal['actions'].append(
                 {int(p): int(a) for p, a in moves.items()})
@@ -516,8 +556,9 @@ class MatchGateway:
 
     def _opponent_act(self, session: MatchSession, p: int,
                       router: RoutedClient,
-                      replica: Optional[str] = None) -> int:
+                      replica: Optional[str] = None, trace=None) -> int:
         env = session.env
+        t0 = time.time()
         reply = router.request(
             session.model, env.observation(p),
             hidden=session.hiddens.get(p),
@@ -525,20 +566,31 @@ class MatchGateway:
             seed=self._seed_seq(session),
             timeout=self.ply_timeout,
             replica=replica if replica is not None
-            else self.ledger.replica_of(session.sid))
+            else self.ledger.replica_of(session.sid),
+            trace=trace)
+        if trace:
+            telemetry.trace_event('gateway_seat', ts=t0,
+                                  dur=time.time() - t0, trace_id=trace,
+                                  sid=session.sid, seat=p)
         session.hiddens[p] = reply.get('hidden')
         return int(reply['action'])
 
     def _opponent_watch(self, session: MatchSession, p: int,
                         router: RoutedClient,
-                        replica: Optional[str] = None) -> None:
+                        replica: Optional[str] = None, trace=None) -> None:
         env = session.env
+        t0 = time.time()
         reply = router.request(
             session.model, env.observation(p),
             hidden=session.hiddens.get(p),
             timeout=self.ply_timeout,
             replica=replica if replica is not None
-            else self.ledger.replica_of(session.sid))
+            else self.ledger.replica_of(session.sid),
+            trace=trace)
+        if trace:
+            telemetry.trace_event('gateway_seat', ts=t0,
+                                  dur=time.time() - t0, trace_id=trace,
+                                  sid=session.sid, seat=p, watch=True)
         session.hiddens[p] = (reply.get('outputs') or {}).get('hidden')
 
     # -- outcome booking ---------------------------------------------------
@@ -587,6 +639,10 @@ class MatchGateway:
         rebuilt state is adopted, proving the journal alone carries the
         match. False (and a drop) on divergence."""
         j = session.journal
+        # link span: the replay-through-a-survivor carries the session's
+        # ORIGINAL open-time trace id, so the SIGKILL reads as one chain
+        tid = session.trace
+        t0 = time.time()
         env = make_env(dict(j['env_args']))
         env.reset()
         hiddens: Dict[int, Any] = {}
@@ -607,11 +663,17 @@ class MatchGateway:
                     j['model'], env.observation(p),
                     hidden=hiddens.get(p),
                     legal=[int(a) for a in env.legal_actions(p)],
-                    seed=seq, timeout=self.ply_timeout)
+                    seed=seq, timeout=self.ply_timeout, trace=tid)
                 hiddens[p] = reply.get('hidden')
                 replayed += 1
                 if int(reply['action']) != step.get(p):
                     self._m_mismatch.inc()
+                    if tid:
+                        telemetry.trace_event(
+                            'gateway_reconstruct', ts=t0,
+                            dur=time.time() - t0, trace_id=tid,
+                            link='reconstruct', sid=session.sid,
+                            replayed=replayed, ok=False)
                     self._drop(session, 'reconstruct action mismatch at '
                                         'ply %d seat %d' % (replayed, p))
                     return False
@@ -619,11 +681,17 @@ class MatchGateway:
                 if p != j['seat']:
                     reply = router.request(j['model'], env.observation(p),
                                            hidden=hiddens.get(p),
-                                           timeout=self.ply_timeout)
+                                           timeout=self.ply_timeout,
+                                           trace=tid)
                     hiddens[p] = (reply.get('outputs') or {}).get('hidden')
             env.step(step)
         if state_digest(hiddens) != j['hidden_digest']:
             self._m_mismatch.inc()
+            if tid:
+                telemetry.trace_event('gateway_reconstruct', ts=t0,
+                                      dur=time.time() - t0, trace_id=tid,
+                                      link='reconstruct', sid=session.sid,
+                                      replayed=replayed, ok=False)
             self._drop(session, 'reconstruct hidden-digest mismatch')
             return False
         session.env = env
@@ -631,6 +699,11 @@ class MatchGateway:
         session.draws = draws
         self._m_reconstructs.inc()
         self._m_replayed.inc(replayed)
+        if tid:
+            telemetry.trace_event('gateway_reconstruct', ts=t0,
+                                  dur=time.time() - t0, trace_id=tid,
+                                  link='reconstruct', sid=session.sid,
+                                  replayed=replayed, ok=True)
         if router.last_replica is not None:
             self.ledger.move(session.sid, router.last_replica)
         _LOG.warning('gateway: reconstructed session %s (%d plies '
@@ -695,8 +768,18 @@ class MatchGateway:
         if not pool:
             return      # nowhere to go yet; next tick retries
         for i, sid in enumerate(sids):
-            self.ledger.move(sid, pool[i % len(pool)])
+            target = pool[i % len(pool)]
+            self.ledger.move(sid, target)
             self._m_handoffs.inc()
+            with self._lock:
+                session = self._sessions.get(sid)
+            if session is not None and session.trace:
+                # link span under the session's original open-time id
+                telemetry.trace_event('gateway_handoff',
+                                      trace_id=session.trace,
+                                      link='handoff', sid=sid,
+                                      from_replica=replica,
+                                      to_replica=target, reason=reason)
         _LOG.warning('gateway: handed %d session(s) off %s (%s)',
                      len(sids), replica, reason)
 
@@ -726,6 +809,28 @@ class MatchGateway:
             sessions = list(self._sessions.values())
         return [s.summary(replica=self.ledger.replica_of(s.sid))
                 for s in sessions]
+
+    def _status_info(self) -> Dict[str, Any]:
+        """/statusz payload for the gateway metrics port: the live
+        session table (main.py --status renders it), session/ply
+        progress, and the gateway's alert state."""
+        with self._lock:
+            lats = list(self._lat_ring)
+        info: Dict[str, Any] = {
+            'sessions': self.session_table(),
+            'progress': {'opened': int(self._m_opened.value),
+                         'plies': int(self._m_plies.value),
+                         'outcomes': int(self._m_outcomes.value),
+                         'handoffs': int(self._m_handoffs.value),
+                         'reconstructs': int(self._m_reconstructs.value),
+                         'dropped': int(self._m_drops.value)},
+            'slo': {'ply_p50_ms': ring_percentile_ms(lats, 0.50),
+                    'ply_p99_ms': ring_percentile_ms(lats, 0.99)},
+        }
+        if self._alerts is not None:
+            info['alerts'] = self._alerts.maybe_evaluate(
+                lambda: [telemetry.snapshot()])
+        return info
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -764,6 +869,11 @@ class GatewayClient:
 
     def _call(self, body: Dict[str, Any],
               timeout: Optional[float] = None) -> Dict[str, Any]:
+        if (body.get('op') in ('open', 'play') and TRACE_KEY not in body
+                and telemetry.trace_enabled()):
+            # mint at the true request edge so the chain starts with the
+            # client; the gateway adopts the id instead of minting its own
+            body = dict(body, **{TRACE_KEY: telemetry.mint_trace_id()})
         reply = self._client.call_admin(body, timeout)
         if reply.get('error'):
             raise ServiceError(str(reply['error']))

@@ -50,7 +50,7 @@ from typing import Any, Dict, Optional, Tuple
 from .. import telemetry
 from ..connection import (FramedConnection, Hub, open_socket_connection,
                           connect_socket_connection, is_infer)
-from ..connection import INFER_KIND
+from ..connection import INFER_KIND, TRACE_KEY
 from ..fault import Backoff
 from ..guard import PREEMPT_EXIT_CODE, PreemptionGuard
 from .client import SERVE_KIND, is_serve, parse_endpoint
@@ -339,9 +339,12 @@ class InferenceService:
         handle = self._intern(line, version)
         model_label = '%s@%s' % (line, version)
         with self._lock:
+            # the trace context (and its wall-clock arrival) rides in the
+            # pending entry so _reply can close the serve_request span
             self._pending[(id(ep), body.get('rid'))] = (
                 time.monotonic(), model_label,
-                self._client_label(ep, body))
+                self._client_label(ep, body),
+                body.get(TRACE_KEY), time.time())  # graftlint: allow[GL001] wall-clock span timestamp for the Chrome trace only — never enters the reply or any episode record
             self._m_inflight.set(len(self._pending))
         self.engines[handle % len(self.engines)].submit(
             ep, dict(body, mid=handle))
@@ -374,12 +377,17 @@ class InferenceService:
             if entry is not None:
                 self._lat_ring.append(time.monotonic() - entry[0])
         if entry is not None:
-            t0, model_label, client_label = entry
-            self._m_latency(model_label, client_label).observe(
-                time.monotonic() - t0)
+            t0, model_label, client_label, trace, t_wall = entry
+            dt = time.monotonic() - t0
+            self._m_latency(model_label, client_label).observe(dt)
             self._m_requests(model_label, client_label).inc()
             if msg.get('error'):
                 self._m_errors('engine').inc()
+            if trace:
+                telemetry.trace_event('serve_request', ts=t_wall, dur=dt,
+                                      trace_id=trace, model=model_label,
+                                      client=client_label,
+                                      replica=self.replica_name or '')
         self.answered += 1
         self.hub.send(ep, (INFER_KIND, msg))
 
@@ -401,6 +409,15 @@ class InferenceService:
                 self.hub.send(ep, (SERVE_KIND, {'error': str(exc)}))
         elif op == 'warm':
             self._warm(ep, str(body.get('model')))
+        elif op == 'trace':
+            # runtime tracing toggle (bench A/B legs flip the SAME warmed
+            # process on and off instead of comparing two cold runs)
+            telemetry.configure_tracing(str(body.get('dir') or ''),
+                                        body.get('rate'), force=True)
+            self.hub.send(ep, (SERVE_KIND,
+                               {'ok': True,
+                                'dir': telemetry.trace_dir(),
+                                'rate': telemetry.trace_sample_rate()}))
         else:
             self.hub.send(ep, (SERVE_KIND,
                                {'error': 'unknown admin op %r' % (op,)}))

@@ -234,6 +234,24 @@ def episode_trace_id(task_args: Optional[Dict[str, Any]]) -> Optional[str]:
     return '%s%d' % (str(task_args.get('role') or 'g'), int(skey))
 
 
+_MINT_LOCK = threading.Lock()
+_MINT_SEQ = [0]                       # guarded-by: _MINT_LOCK
+
+
+def mint_trace_id() -> str:
+    """Serving-path trace context: a fresh request-scoped id (``r<pid
+    hash><seq>``), minted once at the edge (``ServiceClient.submit`` /
+    a gateway ply) and carried inside the INFER/admin payload so every
+    downstream hop — router, replica, engine, failover replay — stamps
+    the SAME id. Unlike :func:`episode_trace_id` there is no
+    server-stamped identity to recompute from, so the id itself crosses
+    the wire (absent key = unsampled; old peers ignore it)."""
+    with _MINT_LOCK:
+        _MINT_SEQ[0] += 1
+        seq = _MINT_SEQ[0]
+    return 'r%x.%d' % (os.getpid() & 0xFFFFFF, seq)
+
+
 def trace_sampled(trace_id) -> bool:
     """Deterministic keep/drop for one episode: hash-based on the trace_id,
     so the learner, gather and worker agree without coordination."""
@@ -1896,6 +1914,32 @@ def render_status(payload: Dict[str, Any]) -> str:
                             row.get('peak_bytes_in_use', 0) / 2**20,
                             ('%.0f MiB' % (limit / 2**20)) if limit
                             else 'unknown'))
+    sessions = payload.get('sessions')
+    if isinstance(sessions, list) and sessions:
+        lines.append('sessions: %d active' % len(sessions))
+        lines.append('  %-12s %-14s %6s %9s %9s %-8s'
+                     % ('sid', 'client', 'plies', 'version', 'ply_p99',
+                        'replica'))
+        for s in sessions:
+            p99 = s.get('ply_p99_ms')
+            lines.append('  %-12s %-14s %6s %9s %9s %-8s'
+                         % (s.get('sid', '?'), s.get('client', '?'),
+                            s.get('plies', 0), s.get('version') or '-',
+                            ('%.1fms' % p99) if p99 is not None else '-',
+                            s.get('replica') or '-'))
+    requests = payload.get('requests')
+    if isinstance(requests, list) and requests:
+        lines.append('requests:')
+        lines.append('  %-10s %8s %9s %9s %9s %9s %s'
+                     % ('replica', 'inflight', 'p50', 'p99', 'received',
+                        'answered', 'state'))
+        for r in requests:
+            lines.append('  %-10s %8s %8.1fms %8.1fms %9s %9s %s'
+                         % (r.get('replica', '?'), r.get('inflight', 0),
+                            float(r.get('p50_ms') or 0.0),
+                            float(r.get('p99_ms') or 0.0),
+                            r.get('received', 0), r.get('answered', 0),
+                            'draining' if r.get('draining') else 'serving'))
     rec = payload.get('recorder')
     if isinstance(rec, dict):
         lines.append('recorder: %s/%s events (%s dropped), %d dump(s)'

@@ -17,7 +17,11 @@ too noisy — and often too small — for scaling thresholds):
     (the controller's ``readmitted`` counter moves);
   * the respawned replica serves byte-identical replies again;
   * SIGTERM drains the whole fleet to exit 75 (EX_TEMPFAIL — the
-    PreemptionGuard supervisor contract).
+    PreemptionGuard supervisor contract);
+  * the collated trace holds >= 1 complete client->router->engine->reply
+    chain — including >= 1 chain that crosses the SIGKILL replay under
+    its ORIGINAL trace_id — and ``trace_report.py --serve --json``
+    exits 0 on it.
 
 Runs under ``HANDYRL_TPU_SANITIZE=1`` in CI like the other chaos legs:
 the lock-order-inversion detector and thread accountant instrument the
@@ -41,6 +45,11 @@ sys.path.insert(0, REPO)
 
 def main() -> int:
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    # serving-path tracing at rate 1.0, inherited by the resolver and
+    # every replica subprocess (telemetry reads the env at import)
+    trace_dir = tempfile.mkdtemp(prefix='fleet_smoke_trace.')
+    os.environ['HANDYRL_TPU_TRACE'] = trace_dir
+    os.environ['HANDYRL_TPU_TRACE_RATE'] = '1'
     import handyrl_tpu
     handyrl_tpu.honor_platform_env()
     from handyrl_tpu.environment import make_env
@@ -78,10 +87,13 @@ def main() -> int:
         refs = [rc.request('default@champion', obs, legal=legal, seed=s)
                 for s in seeds]
 
-        # SIGKILL one replica with a burst in flight
-        rids = [rc.submit('default@champion', obs, legal=legal, seed=s)
-                for s in seeds]
+        # SIGKILL one replica with a burst in flight (the whole burst is
+        # steered onto the victim so the replay path is exercised for
+        # certain, not left to round-robin timing)
         victim = sorted(table)[0]
+        rids = [rc.submit('default@champion', obs, legal=legal, seed=s,
+                          replica=victim)
+                for s in seeds]
         os.kill(table[victim]['pid'], signal.SIGKILL)
         failures = 0
         for rid, ref in zip(rids, refs):
@@ -115,9 +127,31 @@ def main() -> int:
         code = proc.wait(timeout=120)
         assert code == 75, 'fleet exited %s, not 75' % code
 
+        # the collated trace carries the whole causal story: >= 1
+        # complete client->router->engine->reply chain, and >= 1 chain
+        # crossing the SIGKILL replay under its ORIGINAL trace_id
+        from handyrl_tpu import telemetry
+        telemetry.trace_flush()
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, 'scripts', 'trace_report.py'),
+             trace_dir, '--serve', '--json'],
+            capture_output=True, text=True)
+        assert out.returncode == 0, \
+            'trace_report --serve exited %d: %s' % (out.returncode,
+                                                    out.stderr[:500])
+        serve = json.loads(out.stdout)['serve']
+        assert serve['complete_chains'] >= 1, serve
+        assert serve['routed_chains'] >= 1, serve
+        assert serve['replay_chains'] >= 1, serve
+        assert serve['complete_replay_chains'] >= 1, serve
+
         print('fleet smoke OK: %d/%d burst replies byte-identical through '
               'a replica SIGKILL, %s respawned and re-admitted, fleet '
-              'drained to exit 75' % (len(rids), len(rids), victim))
+              'drained to exit 75; trace holds %d complete serve chain(s) '
+              'incl. %d crossing the replay'
+              % (len(rids), len(rids), victim, serve['complete_chains'],
+                 serve['complete_replay_chains']))
         return 0
     finally:
         if rc is not None:
@@ -125,6 +159,7 @@ def main() -> int:
         if proc is not None and proc.poll() is None:
             proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 if __name__ == '__main__':

@@ -21,7 +21,11 @@ contract:
     plus the rated model entry, round-tripped through the on-disk
     rating journal;
   * gateway and fleet SIGTERM drains both exit 75 (EX_TEMPFAIL — the
-    PreemptionGuard supervisor contract).
+    PreemptionGuard supervisor contract);
+  * the collated trace holds >= 1 complete client->router->engine->reply
+    chain and >= 1 journal-reconstruction chain linked to its session's
+    ORIGINAL open-time trace_id, and ``trace_report.py --serve --json``
+    exits 0 on it.
 
 Runs under ``HANDYRL_TPU_SANITIZE=1`` in CI like the other chaos legs.
 Exits 0 on success, 1 with a reason on any failure. Stdlib + repo only.
@@ -47,6 +51,11 @@ ENV = 'HungryGeese'
 
 def main() -> int:
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    # serving-path tracing at rate 1.0, inherited by the fleet, every
+    # replica, and the gateway (telemetry reads the env at import)
+    trace_dir = tempfile.mkdtemp(prefix='gateway_smoke_trace.')
+    os.environ['HANDYRL_TPU_TRACE'] = trace_dir
+    os.environ['HANDYRL_TPU_TRACE_RATE'] = '1'
     import handyrl_tpu
     handyrl_tpu.honor_platform_env()
     from handyrl_tpu.environment import make_env
@@ -198,13 +207,33 @@ def main() -> int:
         code = fleet.wait(timeout=120)
         assert code == 75, 'fleet exited %s, not 75' % code
 
+        # the collated trace reads as one causal chain per session:
+        # >= 1 complete client->router->engine->reply chain, and >= 1
+        # journal reconstruction linked to its session's ORIGINAL
+        # open-time trace_id
+        from handyrl_tpu import telemetry
+        telemetry.trace_flush()
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, 'scripts', 'trace_report.py'),
+             trace_dir, '--serve', '--json'],
+            capture_output=True, text=True)
+        assert out.returncode == 0, \
+            'trace_report --serve exited %d: %s' % (out.returncode,
+                                                    out.stderr[:500])
+        serve = json.loads(out.stdout)['serve']
+        assert serve['complete_chains'] >= 1, serve
+        assert serve['reconstruct_chains'] >= 1, serve
+
         print('gateway smoke OK: %d/%d matches finished through a replica '
               'SIGKILL (%s), %d session(s) journal-reconstructed '
               '(%d plies replayed, 0 mismatches), 0 drops, %d outcomes '
-              'in the RatingBook, both drains exited 75'
+              'in the RatingBook, both drains exited 75; trace holds %d '
+              'complete serve chain(s) and %d reconstruct chain(s)'
               % (len(results), N_SESSIONS, victim,
                  status['reconstructs'], status['replayed_plies'],
-                 status['outcomes']))
+                 status['outcomes'], serve['complete_chains'],
+                 serve['reconstruct_chains']))
         return 0
     finally:
         if rc is not None:
@@ -213,6 +242,7 @@ def main() -> int:
             if proc is not None and proc.poll() is None:
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 if __name__ == '__main__':

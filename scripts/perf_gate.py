@@ -11,6 +11,11 @@ the per-row noise tolerance below it.
 
 Row handling:
   * rows without a numeric 'value' (pre-schema-v2 history) are skipped;
+  * some row kinds stamp hard-bounded side fields (BOUNDED_FIELDS) that
+    gate against a fixed ceiling rather than the history median — e.g.
+    ``tracing_overhead_pct`` on the serving rows must stay <= 2% (the
+    tracing-on/off A/B pair, docs/observability.md "Serving-path
+    tracing"); a row that predates the field skips the bound;
   * rows marked ``degraded: true`` (a TPU request that fell back to CPU —
     bench.py stamps backend_requested/backend_actual) never gate and never
     enter the baseline: comparing a fallback row against silicon history
@@ -54,6 +59,16 @@ ROW_TOLERANCE_PCT = {
     'bench-gateway': 30.0,        # session tier: subprocess + chaos noise
     'bench-headline': 15.0,    # compiled step timing is steadier
     'bench-mesh': 20.0,
+}
+
+# hard-bounded side fields: {row kind: {field: max allowed}}. Unlike the
+# median gate these are absolute ceilings — the serving tracing A/B pair
+# must cost <= 2% regardless of what history says. Rows that predate a
+# field simply skip its bound.
+BOUNDED_FIELDS: Dict[str, Dict[str, float]] = {
+    'bench-serve': {'tracing_overhead_pct': 2.0},
+    'bench-serve-device': {'tracing_overhead_pct': 2.0},
+    'bench-gateway': {'tracing_overhead_pct': 2.0},
 }
 
 Key = Tuple[str, str, str]
@@ -117,6 +132,25 @@ def gate_key(key: Key, prior: List[float], fresh: float, tol_pct: float,
     return ('regress' if fresh < floor else 'pass'), detail
 
 
+def gate_bounds(key: Key, row: Dict[str, Any]):
+    """Hard-bounded side fields for one fresh row: list of
+    ('pass'|'regress', field, detail) — empty when the row kind has no
+    bounds or the row predates the field."""
+    out = []
+    for field, bound in sorted(BOUNDED_FIELDS.get(key[0], {}).items()):
+        if field not in row:
+            continue
+        try:
+            val = float(row[field])
+        except (TypeError, ValueError):
+            out.append(('regress', field,
+                        '%s=%r is not numeric' % (field, row[field])))
+            continue
+        out.append(('pass' if val <= bound else 'regress', field,
+                    '%s %.2f vs ceiling %.2f' % (field, val, bound)))
+    return out
+
+
 def main(argv=None) -> int:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -171,7 +205,8 @@ def main(argv=None) -> int:
             per_key.setdefault(row_key(row), []).append(row)
 
     # the rows under test: one external fresh row, or the newest per key
-    fresh_rows: List[Tuple[Key, float]] = []
+    # (the full dict rides along for the bounded side fields)
+    fresh_rows: List[Tuple[Key, float, Dict[str, Any]]] = []
     if args.fresh:
         try:
             with open(args.fresh) as fh:
@@ -187,14 +222,15 @@ def main(argv=None) -> int:
             print('perf_gate: fresh row not gate-eligible (%s) — skipping'
                   % why, file=sys.stderr)
             return 0 if args.allow_insufficient else 2
-        fresh_rows.append((row_key(fresh), float(fresh['value'])))
+        fresh_rows.append((row_key(fresh), float(fresh['value']), fresh))
     else:
         for key, rows in per_key.items():
-            fresh_rows.append((key, float(rows[-1]['value'])))
+            fresh_rows.append((key, float(rows[-1]['value']), rows[-1]))
             per_key[key] = rows[:-1]   # priors exclude the row under test
 
     if args.key:
-        fresh_rows = [(k, v) for k, v in fresh_rows if k[0] == args.key]
+        fresh_rows = [(k, v, r) for k, v, r in fresh_rows
+                      if k[0] == args.key]
 
     baseline_map: Dict[str, float] = {}
     if args.baseline and os.path.exists(args.baseline) \
@@ -214,7 +250,7 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         out = {}
-        for key, fresh_val in fresh_rows:
+        for key, fresh_val, _row in fresh_rows:
             vals = [float(r['value']) for r in per_key.get(key, [])]
             vals.append(fresh_val)
             out['|'.join(key)] = round(median(vals), 4)
@@ -229,7 +265,7 @@ def main(argv=None) -> int:
         return 0 if args.allow_insufficient else 2
 
     worst = 0
-    for key, fresh_val in sorted(fresh_rows):
+    for key, fresh_val, row in sorted(fresh_rows, key=lambda t: t[:2]):
         prior = [float(r['value']) for r in per_key.get(key, [])]
         verdict, detail = gate_key(
             key, prior, fresh_val, tolerance_for(key, overrides),
@@ -240,6 +276,11 @@ def main(argv=None) -> int:
             worst = max(worst, 1)
         elif verdict == 'insufficient' and not args.allow_insufficient:
             worst = max(worst, 2) if worst != 1 else worst
+        for bverdict, _field, bdetail in gate_bounds(key, row):
+            print('perf_gate: %-10s %s: %s' % (bverdict.upper(),
+                                               '/'.join(key), bdetail))
+            if bverdict == 'regress':
+                worst = max(worst, 1)
     return worst
 
 

@@ -14,11 +14,23 @@ generation->gradient p50/p95, and the batch-level stage summaries
 (select/decode/assemble/ipc/h2d/compute/engine_batch). ``--chrome OUT``
 additionally writes one merged Chrome-trace JSON across every run found.
 
-Exit code: 0 when at least one complete generation->gradient chain was
-found, 2 otherwise (the CI smoke asserts 0). Stdlib only.
+``--serve`` additionally reduces the serving-path spans (PR 18,
+docs/observability.md "Serving-path tracing"): per-hop latency
+percentiles (client_request -> route_dispatch -> serve_request ->
+queue_wait -> engine_batch), the queue-wait vs batch-compute split per
+replica, failover replay / journal-reconstruction chain extraction (link
+spans carrying the ORIGINAL trace_id), and per-session gateway ply
+timelines.
+
+Exit code: 0 when at least one complete chain of the required kind was
+found, 2 otherwise (the CI smokes assert 0). ``--require
+training|serve|any`` picks the kind; the default is ``training`` unless
+``--serve`` is given (so serve-only runs, with no learner, don't report
+failure). Stdlib only.
 
 Usage:
     python scripts/trace_report.py <dir-or-file> [--chrome OUT] [--json]
+                                   [--serve] [--require KIND]
 """
 
 from __future__ import annotations
@@ -40,6 +52,19 @@ CHAIN_STAGES = ('task_assign', 'generate', 'upload', 'ingest', 'train_step')
 BATCH_STAGES = ('select', 'decode', 'assemble', 'ipc', 'h2d', 'dispatch',
                 'host_block', 'engine_batch', 'generate', 'upload',
                 'evaluate')
+
+# the serving-path request chain, in causal order (client submit ->
+# router dispatch -> replica service -> engine queue -> forward batch)
+SERVE_CHAIN_STAGES = ('client_request', 'route_dispatch', 'serve_request',
+                      'queue_wait', 'engine_batch')
+
+# link spans: re-dispatches that carry the ORIGINAL trace_id so a
+# failover reads as one causal chain (args.link names the kind)
+SERVE_LINK_STAGES = ('router_replay', 'gateway_handoff',
+                     'gateway_reconstruct')
+
+# gateway session-tier spans (per-session ply timelines)
+GATEWAY_STAGES = ('gateway_open', 'gateway_ply', 'gateway_seat')
 
 
 def discover_files(path: str) -> List[str]:
@@ -121,6 +146,121 @@ def chain_errors(stages: Dict[str, Tuple[int, int, int]]) -> List[str]:
     return errors
 
 
+def build_serve_chains(events: List[Dict[str, Any]]
+                       ) -> Dict[str, Dict[str, Any]]:
+    """trace_id -> serving-path chain record: the earliest event per hop
+    stage (``engine_batch`` links through ``args.trace_ids``, like
+    train_step), plus EVERY link span (replays/handoffs/reconstructs
+    repeat legitimately — each one is part of the causal story, not a
+    retry to collapse)."""
+    chains: Dict[str, Dict[str, Any]] = defaultdict(
+        lambda: {'stages': {}, 'links': []})
+
+    def note(tid, stage, ev):
+        stages = chains[tid]['stages']
+        cur = stages.get(stage)
+        ent = (int(ev.get('ts', 0)), int(ev.get('dur', 0)),
+               int(ev.get('pid', 0)))
+        if cur is None or ent[0] < cur[0]:
+            stages[stage] = ent
+
+    for ev in events:
+        if ev.get('ph') != 'X':
+            continue
+        args = ev.get('args') or {}
+        name = ev.get('name')
+        tid = args.get('trace_id')
+        if tid:
+            if name in SERVE_CHAIN_STAGES or name in GATEWAY_STAGES:
+                note(tid, name, ev)
+            if name in SERVE_LINK_STAGES:
+                chains[tid]['links'].append(dict(args, stage=name,
+                                                 ts=int(ev.get('ts', 0))))
+        if name == 'engine_batch':
+            for linked in (args.get('trace_ids') or ()):
+                note(linked, 'engine_batch', ev)
+    return dict(chains)
+
+
+def serve_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``--serve`` report block: chain counts (complete / replay /
+    reconstruct), per-hop latency percentiles, the per-replica
+    queue-wait vs batch-compute split, and per-session ply timelines."""
+    chains = build_serve_chains(events)
+
+    # pid -> replica name, learned from serve_request spans (the engine
+    # runs in the service process, so its queue_wait/engine_batch events
+    # share the pid)
+    pid_replica: Dict[int, str] = {}
+    for ev in events:
+        if ev.get('ph') == 'X' and ev.get('name') == 'serve_request':
+            replica = (ev.get('args') or {}).get('replica')
+            if replica:
+                pid_replica.setdefault(int(ev.get('pid', 0)), str(replica))
+
+    hop_durs: Dict[str, List[float]] = defaultdict(list)
+    split: Dict[str, Dict[str, List[float]]] = defaultdict(
+        lambda: {'queue_wait': [], 'engine_batch': []})
+    sessions: Dict[str, List[Tuple[int, int]]] = defaultdict(list)
+    for ev in events:
+        if ev.get('ph') != 'X':
+            continue
+        name = ev.get('name')
+        dur_s = int(ev.get('dur', 0)) / 1e6
+        if name in SERVE_CHAIN_STAGES or name in GATEWAY_STAGES:
+            hop_durs[name].append(dur_s)
+        if name in ('queue_wait', 'engine_batch'):
+            replica = pid_replica.get(int(ev.get('pid', 0)))
+            if replica is not None:
+                split[replica][name].append(dur_s)
+        if name == 'gateway_ply':
+            sid = (ev.get('args') or {}).get('sid')
+            if sid is not None:
+                sessions[str(sid)].append((int(ev.get('ts', 0)),
+                                           int(ev.get('dur', 0))))
+
+    complete = routed = replays = complete_replays = reconstructs = 0
+    for rec in chains.values():
+        stages, links = rec['stages'], rec['links']
+        is_complete = all(s in stages for s in
+                          ('client_request', 'serve_request', 'engine_batch'))
+        has_replay = any(l['stage'] == 'router_replay' for l in links)
+        complete += is_complete
+        routed += is_complete and 'route_dispatch' in stages
+        replays += has_replay
+        complete_replays += is_complete and has_replay
+        reconstructs += ('gateway_open' in stages
+                         and any(l['stage'] == 'gateway_reconstruct'
+                                 for l in links))
+
+    def pcts(d: List[float]) -> Dict[str, Any]:
+        return {'n': len(d), 'p50': round(percentile(d, 0.50), 6),
+                'p95': round(percentile(d, 0.95), 6),
+                'p99': round(percentile(d, 0.99), 6)}
+
+    return {
+        'chains': len(chains),
+        'complete_chains': complete,
+        'routed_chains': routed,
+        'replay_chains': replays,
+        'complete_replay_chains': complete_replays,
+        'reconstruct_chains': reconstructs,
+        'hop_seconds': {name: pcts(d)
+                        for name, d in sorted(hop_durs.items())},
+        'replica_split': {
+            replica: {'queue_wait': pcts(d['queue_wait']),
+                      'engine_batch': pcts(d['engine_batch'])}
+            for replica, d in sorted(split.items())},
+        'sessions': {
+            sid: {'plies': len(rows),
+                  'ply_seconds': pcts([dur / 1e6 for _ts, dur in rows]),
+                  'span_seconds': round(
+                      (max(ts + dur for ts, dur in rows)
+                       - min(ts for ts, _d in rows)) / 1e6, 6)}
+            for sid, rows in sorted(sessions.items())},
+    }
+
+
 def percentile(values: List[float], q: float) -> float:
     if not values:
         return 0.0
@@ -129,7 +269,8 @@ def percentile(values: List[float], q: float) -> float:
     return vals[idx]
 
 
-def summarize(events: List[Dict[str, Any]], as_json: bool = False) -> int:
+def summarize(events: List[Dict[str, Any]], as_json: bool = False,
+              serve: bool = False, require: str = 'training') -> int:
     chains = build_chains(events)
     pids = {ev.get('pid') for ev in events if ev.get('ph') == 'X'}
 
@@ -175,6 +316,9 @@ def summarize(events: List[Dict[str, Any]], as_json: bool = False) -> int:
             'n': len(totals), 'p50': round(percentile(totals, 0.50), 6),
             'p95': round(percentile(totals, 0.95), 6)},
     }
+    sv = serve_summary(events)
+    if serve:
+        report['serve'] = sv
     if as_json:
         print(json.dumps(report))
     else:
@@ -193,7 +337,40 @@ def summarize(events: List[Dict[str, Any]], as_json: bool = False) -> int:
         g2g = report['generation_to_gradient_seconds']
         print('generation->gradient: p50=%g p95=%g n=%d'
               % (g2g['p50'], g2g['p95'], g2g['n']))
-    return 0 if complete > 0 else 2
+        if serve:
+            print('serving path: %d request chains (%d complete, %d '
+                  'routed), %d replay chain(s) (%d complete), %d '
+                  'reconstruct chain(s)'
+                  % (sv['chains'], sv['complete_chains'],
+                     sv['routed_chains'], sv['replay_chains'],
+                     sv['complete_replay_chains'],
+                     sv['reconstruct_chains']))
+            print('per-hop latency (s):')
+            for name, row in sv['hop_seconds'].items():
+                print('  %-14s p50=%-10g p95=%-10g p99=%-10g n=%d'
+                      % (name, row['p50'], row['p95'], row['p99'],
+                         row['n']))
+            for replica, row in sv['replica_split'].items():
+                print('replica %s: queue_wait p50=%g p99=%g (n=%d) | '
+                      'engine_batch p50=%g p99=%g (n=%d)'
+                      % (replica,
+                         row['queue_wait']['p50'], row['queue_wait']['p99'],
+                         row['queue_wait']['n'],
+                         row['engine_batch']['p50'],
+                         row['engine_batch']['p99'],
+                         row['engine_batch']['n']))
+            for sid, row in sv['sessions'].items():
+                print('session %s: %d plies over %gs, ply p50=%g p99=%g'
+                      % (sid, row['plies'], row['span_seconds'],
+                         row['ply_seconds']['p50'],
+                         row['ply_seconds']['p99']))
+    ok_training = complete > 0
+    ok_serve = sv['complete_chains'] > 0
+    if require == 'serve':
+        return 0 if ok_serve else 2
+    if require == 'any':
+        return 0 if (ok_training or ok_serve) else 2
+    return 0 if ok_training else 2
 
 
 def main(argv=None) -> int:
@@ -204,7 +381,16 @@ def main(argv=None) -> int:
                         help='also write one merged Chrome-trace JSON')
     parser.add_argument('--json', action='store_true',
                         help='machine-readable summary (one JSON object)')
+    parser.add_argument('--serve', action='store_true',
+                        help='also reduce the serving-path spans (per-hop '
+                             'percentiles, replica split, replay chains, '
+                             'session timelines)')
+    parser.add_argument('--require', choices=('training', 'serve', 'any'),
+                        default=None,
+                        help='which chain kind must be complete for exit 0 '
+                             '(default: serve when --serve, else training)')
     opts = parser.parse_args(argv)
+    require = opts.require or ('serve' if opts.serve else 'training')
 
     files = discover_files(opts.path)
     if not files:
@@ -216,7 +402,8 @@ def main(argv=None) -> int:
             json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
         print('merged Chrome trace -> %s (%d events)'
               % (opts.chrome, len(events)), file=sys.stderr)
-    return summarize(events, as_json=opts.json)
+    return summarize(events, as_json=opts.json, serve=opts.serve,
+                     require=require)
 
 
 if __name__ == '__main__':

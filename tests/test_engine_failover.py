@@ -185,8 +185,13 @@ def test_supervisor_restarts_crashed_engine_with_error_fanout():
         # is error-answered by the crash fan-out, not silently dropped
         reply = ep.replies.get(timeout=10)
         assert reply['rid'] == 1 and 'crashed' in reply['error']
+        # wait for the DECLARED restart, not just a live engine thread —
+        # the crashed engine's thread lingers in its crash handler for a
+        # beat, so thread_alive() alone passes before the watchdog's first
+        # tick and reads restarts too early
         assert _wait_for(
-            lambda: sup.engine is not None and sup.engine.thread_alive(), 15)
+            lambda: (sup.restarts >= 1 and sup.engine is not None
+                     and sup.engine.thread_alive()), 15)
         assert sup.restarts == 1
         assert (_counter_value('engine_restarts_total', reason='crash')
                 == crashes_before + 1)

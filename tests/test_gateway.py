@@ -381,3 +381,89 @@ def test_gateway_reconstruct_replays_journal_byte_identical(tmp_path):
         for svc in services:
             svc.stop(drain=False)
         resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
+def test_gateway_reconstruct_spans_link_open_trace(tmp_path):
+    """Serving-path tracing through a journal reconstruction: the
+    ``gateway_reconstruct`` link span carries the session's ORIGINAL
+    open-time trace_id (adopted at ``_op_open``), every ply span points
+    back at it via ``session_trace``, and a refused (tampered-journal)
+    reconstruction emits the link span with ``ok`` false — the whole
+    session reads as one causal chain."""
+    import glob
+    import json
+
+    from handyrl_tpu import telemetry
+    from handyrl_tpu.serving.gateway import MatchGateway
+    resolver, services, _w = _in_process_fleet(tmp_path)
+    trace_d = str(tmp_path / 'traces')
+    telemetry.configure_tracing(trace_d, 1.0, force=True)
+    gw = MatchGateway(_gw_args(tmp_path, resolver.port))
+    try:
+        rng = random.Random(3)
+        reply = gw._op_open({'env': 'TicTacToe', 'seat': 0,
+                             'client': 'tr', 'seed': 31})
+        sid = reply['sid']
+        session = gw._sessions[sid]
+        assert session.trace, 'open did not mint a session trace id'
+        for _ in range(2):
+            reply = gw._op_play({'sid': sid,
+                                 'action': int(rng.choice(reply['legal']))})
+            assert 'error' not in reply, reply
+        assert gw._reconstruct(session, gw._router())
+
+        # tampered journal: the refusal is traced too (ok: false)
+        reply2 = gw._op_open({'env': 'TicTacToe', 'seat': 0,
+                              'client': 'tamper', 'seed': 37})
+        sid2 = reply2['sid']
+        reply2 = gw._op_play({'sid': sid2,
+                              'action': int(rng.choice(reply2['legal']))})
+        session2 = gw._sessions[sid2]
+        tid2 = session2.trace
+        session2.journal['hidden_digest'] = '0' * 40
+        assert not gw._reconstruct(session2, gw._router())
+
+        telemetry.trace_flush()
+        events = []
+        for path in glob.glob(os.path.join(trace_d, 'trace-*.jsonl')):
+            events.extend(json.loads(l) for l in open(path) if l.strip())
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e['name'], []).append(e)
+        opens = [e for e in by_name.get('gateway_open', ())
+                 if e['args']['sid'] == sid]
+        assert len(opens) == 1
+        assert opens[0]['args']['trace_id'] == session.trace
+        recs = [e for e in by_name.get('gateway_reconstruct', ())
+                if e['args']['sid'] == sid]
+        assert len(recs) == 1, recs
+        assert recs[0]['args']['trace_id'] == session.trace
+        assert recs[0]['args']['link'] == 'reconstruct'
+        assert recs[0]['args']['ok'] is True
+        assert recs[0]['args']['replayed'] >= 2
+        # every ply span points back at the session's open-time chain
+        plies = [e for e in by_name.get('gateway_ply', ())
+                 if e['args']['sid'] == sid]
+        assert len(plies) == 2
+        assert all(e['args']['session_trace'] == session.trace
+                   for e in plies)
+        # opponent-seat fan-out rode the same chain into the fleet
+        seats = [e for e in by_name.get('gateway_seat', ())
+                 if e['args']['sid'] == sid]
+        assert seats, 'no gateway_seat spans for the traced session'
+        # the refused reconstruction links the tampered session's own id
+        recs2 = [e for e in by_name.get('gateway_reconstruct', ())
+                 if e['args']['sid'] == sid2]
+        assert len(recs2) == 1
+        assert recs2[0]['args']['trace_id'] == tid2
+        assert recs2[0]['args']['ok'] is False
+    finally:
+        telemetry.trace_flush()
+        telemetry.configure_tracing('', 1.0, force=True)
+        os.environ.pop('HANDYRL_TPU_TRACE', None)
+        os.environ.pop('HANDYRL_TPU_TRACE_RATE', None)
+        _gateway_close(gw)
+        for svc in services:
+            svc.stop(drain=False)
+        resolver.stop(drain=False)

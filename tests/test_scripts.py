@@ -201,3 +201,78 @@ def test_trace_report_json_schema(tmp_path, capsys):
     empty = tmp_path / 'empty'
     empty.mkdir()
     assert trace_report.main([str(empty)]) == 2
+
+
+def test_trace_report_serve_mode_and_require(tmp_path, capsys):
+    """``--serve`` reduces the serving-path spans (hop percentiles, the
+    per-replica queue/compute split, replay + reconstruction chains,
+    session timelines) and flips the exit contract to "a complete serve
+    chain exists"; ``--require`` picks the chain kind explicitly so a
+    serve-only trace doesn't read as a training failure."""
+    import json
+
+    import trace_report
+
+    def ev(name, ts, dur, pid, trace_id=None, trace_ids=None, **extra):
+        args = dict(extra)
+        if trace_id:
+            args['trace_id'] = trace_id
+        if trace_ids:
+            args['trace_ids'] = trace_ids
+        return json.dumps({'name': name, 'cat': 'handyrl', 'ph': 'X',
+                           'ts': ts, 'dur': dur, 'pid': pid, 'tid': 1,
+                           'args': args})
+
+    trace = tmp_path / 'trace-serve1.jsonl'
+    trace.write_text('\n'.join([
+        # request r1: a complete routed chain crossing a failover replay
+        # (the link span carries the ORIGINAL trace id)
+        ev('client_request', 1000, 9000, 1, trace_id='r1'),
+        ev('route_dispatch', 1200, 50, 1, trace_id='r1', replica='r0',
+           breaker='closed'),
+        ev('router_replay', 4000, 80, 1, trace_id='r1', link='replay',
+           from_replica='r0', to_replica='r1'),
+        ev('serve_request', 5000, 2000, 20, trace_id='r1', replica='r1'),
+        ev('queue_wait', 5200, 300, 20, trace_id='r1'),
+        ev('engine_batch', 5600, 900, 20, trace_ids=['r1']),
+        # session s1: open + 2 plies + a journal reconstruction linked to
+        # the session's open-time trace id
+        ev('gateway_open', 500, 100, 3, trace_id='g1', sid='s1'),
+        ev('gateway_ply', 2000, 400, 3, trace_id='p1', sid='s1',
+           session_trace='g1'),
+        ev('gateway_ply', 3000, 500, 3, trace_id='p2', sid='s1',
+           session_trace='g1'),
+        ev('gateway_reconstruct', 6000, 700, 3, trace_id='g1',
+           link='reconstruct', sid='s1', replayed=2, ok=True),
+    ]) + '\n')
+
+    assert trace_report.main([str(tmp_path), '--serve', '--json']) == 0
+    sv = json.loads(capsys.readouterr().out)['serve']
+    assert sv['complete_chains'] == 1
+    assert sv['routed_chains'] == 1
+    assert sv['replay_chains'] == 1
+    assert sv['complete_replay_chains'] == 1
+    assert sv['reconstruct_chains'] == 1
+    for name in ('client_request', 'route_dispatch', 'serve_request',
+                 'queue_wait', 'engine_batch', 'gateway_open',
+                 'gateway_ply'):
+        row = sv['hop_seconds'][name]
+        assert set(row) == {'n', 'p50', 'p95', 'p99'} and row['n'] >= 1
+    # the queue-wait vs batch-compute split keys on the replica learned
+    # from serve_request (the engine shares the service pid)
+    assert sv['replica_split']['r1']['queue_wait']['n'] == 1
+    assert sv['replica_split']['r1']['engine_batch']['n'] == 1
+    assert sv['sessions']['s1']['plies'] == 2
+    assert sv['sessions']['s1']['span_seconds'] == pytest.approx(0.0015)
+
+    # exit contract: the default (training) still fails this serve-only
+    # trace; --require any accepts either kind; --serve with an explicit
+    # --require training renders the block but gates on training
+    assert trace_report.main([str(tmp_path), '--json']) == 2
+    capsys.readouterr()
+    assert trace_report.main([str(tmp_path), '--json',
+                              '--require', 'any']) == 0
+    capsys.readouterr()
+    assert trace_report.main([str(tmp_path), '--serve',
+                              '--require', 'training']) == 2
+    capsys.readouterr()

@@ -852,6 +852,87 @@ def test_routed_client_chaos_failover_byte_identical(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_failover_replay_links_original_trace_id(tmp_path):
+    """Serving-path tracing across a failover: a request stranded by a
+    dead replica is replayed on the survivor under its ORIGINAL trace_id,
+    with a ``router_replay`` link span — the SIGKILL reads as one causal
+    chain (client_request + serve_request + engine_batch all share the
+    id) instead of two broken halves."""
+    import glob
+
+    from handyrl_tpu import telemetry
+    from handyrl_tpu.serving.fleet import RoutedClient, ServiceResolver
+    from tests.proxy import ChaosProxy
+    env, w = _ttt_wrapper()
+    obs = env.observation(0)
+    legal = env.legal_actions(0)
+    ModelRegistry(str(tmp_path)).publish('default', snapshot=w.snapshot(),
+                                         version=1, promote=True)
+    trace_d = str(tmp_path / 'traces')
+    telemetry.configure_tracing(trace_d, 1.0, force=True)
+    resolver = ServiceResolver(_fleet_args(
+        tmp_path, heartbeat_timeout=60.0)).start()
+    svc_a = InferenceService(_service_args(str(tmp_path))).start()
+    svc_b = InferenceService(_service_args(str(tmp_path))).start()
+    proxy = ChaosProxy(target_port=svc_a.port)     # a dies through this
+    admin = ServiceClient('127.0.0.1', resolver.port, name='ops')
+    admin._call_admin({'op': 'register', 'replica': 'a',
+                       'endpoint': '127.0.0.1:%d' % proxy.port, 'pid': 0})
+    admin._call_admin({'op': 'register', 'replica': 'b',
+                       'endpoint': '127.0.0.1:%d' % svc_b.port, 'pid': 0})
+    rc = RoutedClient('127.0.0.1', resolver.port, timeout=15.0,
+                      refresh_interval=0.2)
+    try:
+        seeds = [sample_seed(11, (0, k), 0) for k in range(4)]
+        for s in seeds:                       # warm both replicas/engines
+            rc.request('default@champion', obs, legal=legal, seed=s)
+        # a burst with caller-supplied trace context, steered onto the
+        # victim (so replay is exercised for certain), then kill it
+        tids = ['pr18test%d' % k for k in range(4)]
+        rids = [rc.submit('default@champion', obs, legal=legal, seed=s,
+                          replica='a', trace=t)
+                for s, t in zip(seeds, tids)]
+        proxy.accepting = False
+        proxy.sever()
+        for rid in rids:
+            rc.collect(rid)                   # replays ride replica b
+
+        telemetry.trace_flush()
+        events = []
+        for path in glob.glob(os.path.join(trace_d, 'trace-*.jsonl')):
+            events.extend(json.loads(l) for l in open(path) if l.strip())
+        replays = [e for e in events if e['name'] == 'router_replay']
+        assert replays, 'the severed burst produced no replay link spans'
+        for e in replays:
+            assert e['args']['trace_id'] in tids
+            assert e['args']['link'] == 'replay'
+            assert e['args']['to_replica'] == 'b'
+        # every replayed request still reads as ONE complete chain
+        for tid in {e['args']['trace_id'] for e in replays}:
+            names = set()
+            for e in events:
+                a = e.get('args') or {}
+                if a.get('trace_id') == tid or \
+                        tid in (a.get('trace_ids') or ()):
+                    names.add(e['name'])
+            for stage in ('client_request', 'route_dispatch',
+                          'serve_request', 'queue_wait', 'engine_batch'):
+                assert stage in names, 'chain %s missing %s: %s' \
+                    % (tid, stage, sorted(names))
+    finally:
+        telemetry.trace_flush()
+        telemetry.configure_tracing('', 1.0, force=True)
+        os.environ.pop('HANDYRL_TPU_TRACE', None)
+        os.environ.pop('HANDYRL_TPU_TRACE_RATE', None)
+        rc.close()
+        admin.close()
+        proxy.close()
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+        resolver.stop(drain=False)
+
+
+@pytest.mark.timeout(300)
 def test_fleet_rolling_promote_warms_before_flip(tmp_path):
     """A rolling promote warms every routable replica (the warm admin op
     materializes + compiles the candidate) BEFORE the champion flips, and

@@ -381,12 +381,14 @@ def test_recorder_only_toggle_leaves_metrics_live():
 
 
 def test_log_warnings_land_in_recorder():
-    before = len([e for e in telemetry.recorder().events()
-                  if e.get('kind') == 'log'])
+    # compare the monotonic total, not a kind-filtered length: once the
+    # ring reaches capacity (easy in a long suite run) every append
+    # evicts an old event and the filtered count stays flat
+    before = telemetry.recorder_stats()['total']
     telemetry.get_logger('recorder-test').warning('recorder mirror check')
+    assert telemetry.recorder_stats()['total'] > before
     logged = [e for e in telemetry.recorder().events()
               if e.get('kind') == 'log']
-    assert len(logged) > before
     assert any('recorder mirror check' in e['msg'] for e in logged)
 
 
