@@ -569,6 +569,50 @@ def run_ingest(probe: dict):
         perf_plane_overhead = (100.0 * (1.0 - perf_plane_on_bps /
                                         perf_plane_off_bps)
                                if perf_plane_off_bps else 0.0)
+        # spool-on vs spool-off pair: the durable plane's episode WAL
+        # (spool.EpisodeSpool) rides the ingest hot path — one CRC-framed
+        # msgpack record per ADMITTED episode, packed + appended before
+        # the episode is counted. An episode is admitted once but sampled
+        # into many batches, so the honest coupling spools the full
+        # buffer exactly once per measured leg, the admission writes
+        # interleaved evenly across the builds that consume them (one
+        # append per built batch would bill the WAL len(leg)/n_eps times
+        # over). Same alternating best-of-5 discipline, acceptance <= 2%
+        # (scripts/perf_gate.py 'bench-ingest')
+        import threading
+        from handyrl_tpu.connection import pack as conn_pack
+        from handyrl_tpu.spool import EpisodeSpool
+        spool_root = tempfile.mkdtemp(prefix='bench_spool.')
+        spool = EpisodeSpool(spool_root, segment_mb=64, keep_segments=2)
+        spool_lock = threading.Lock()   # batcher threads share the WAL
+        spool_idx = [0]
+        builds_per_leg = n_batches * 5
+        append_stride = max(1, builds_per_leg // len(episodes))
+
+        def spooled_build(sel, a, timer=None, cache=None):
+            with spool_lock:
+                idx = spool_idx[0]
+                spool_idx[0] += 1
+                if idx % append_stride == 0:
+                    ep = episodes[(idx // append_stride) % len(episodes)]
+                    spool.append(idx, conn_pack({'idx': idx, 'episode': ep}))
+            return make_batch(sel, a, timer=timer, cache=cache)
+
+        sp_rounds = []
+        try:
+            for _ in range(5):
+                sp_on = _measure_ingest(spooled_build, episodes, args,
+                                        n_batches * 5)
+                sp_off = _measure_ingest(make_batch, episodes, args,
+                                         n_batches * 5)
+                sp_rounds.append((sp_on, sp_off))
+        finally:
+            spool.close()
+            shutil.rmtree(spool_root, ignore_errors=True)
+        spool_on_bps = max(on for on, _ in sp_rounds)
+        spool_off_bps = max(off for _, off in sp_rounds)
+        spool_overhead = (100.0 * (1.0 - spool_on_bps / spool_off_bps)
+                          if spool_off_bps else 0.0)
 
     default_geom = (B == 128 and T == 16)
     # stage keys in the canonical telemetry order (telemetry.INGEST_STAGES
@@ -596,6 +640,9 @@ def run_ingest(probe: dict):
          perf_plane_on_batches_per_sec=round(perf_plane_on_bps, 2),
          perf_plane_off_batches_per_sec=round(perf_plane_off_bps, 2),
          perf_plane_overhead_pct=round(perf_plane_overhead, 2),
+         spool_on_batches_per_sec=round(spool_on_bps, 2),
+         spool_off_batches_per_sec=round(spool_off_bps, 2),
+         spool_overhead_pct=round(spool_overhead, 2),
          geometry=('headline' if default_geom else 'dryrun'))
 
 

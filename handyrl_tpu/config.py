@@ -98,6 +98,19 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
     },
     'keep_checkpoints': 0,        # GC numbered models/<epoch>.ckpt beyond the newest N after each save (0 = keep all; league-opponent checkpoint paths are never deleted)
 
+    # durable training plane (spool.py EpisodeSpool + fault.LedgerJournal,
+    # docs/large_scale_training.md "Zero-loss training plane"): a SIGKILLed
+    # remote learner restarts with zero admitted episodes lost — episodes
+    # WAL to a segmented spool before they are counted, the task ledger's
+    # outstanding book persists snapshot+delta, and surviving gathers
+    # reattach through the resume-token handshake instead of respawning
+    'durability': {
+        'spool': True,            # WAL every admitted episode under model_dir/spool/ before feed_episodes counts it (remote learners only; a restart replays records past the newest checkpoint's consumption horizon back into the buffer)
+        'segment_mb': 64,         # spool segment rotation size (MB); only the live segment can hold a torn tail
+        'keep_segments': 2,       # closed segments retained past the GC horizon as cushion (GC runs at each epoch sync; disk stays ~= (keep_segments + 1) * segment_mb + live)
+        'ledger_snapshot': True,  # persist the TaskLedger book (ledger.snap at each epoch + ledger.delta.wal between), so a restarted learner re-issues stranded tasks with their ORIGINAL sample_keys
+    },
+
     # per-host batched inference service for the distributed actor fleet
     # (inference.py, docs/large_scale_training.md "Actor inference service"):
     # workers become pure env-steppers; one engine per host coalesces their
@@ -200,6 +213,7 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'min_sigma': 50.0,       # sigma floor under track_sigma (effective K never collapses to 0)
         'promote_margin': 30.0,  # rating-gated promotion: the learner must clear the incumbent champion member's rating by this many Elo points
         'min_games': 20,         # rated games the learner must book since the last champion flip before promotion is considered
+        'rating_flush_seconds': 5.0,  # write the rating journal through after an outcome lands, at most this often (s) — a hard-killed learner loses at most this window of ratings instead of a whole epoch; 0 = epoch-sync flushes only
     },
 
     # fleet generation backend (worker.py gather_loop + DeviceActorGather,
@@ -327,6 +341,15 @@ def validate(args: Dict[str, Any]) -> None:
         'valid checkpoint)'
     assert int(ta.get('keep_checkpoints') or 0) >= 0, \
         'keep_checkpoints must be >= 0 (0 keeps every checkpoint)'
+    dur = ta.get('durability') or {}
+    assert isinstance(dur, dict), \
+        'durability must be a block (spool / segment_mb / keep_segments / ' \
+        'ledger_snapshot)'
+    assert float(dur.get('segment_mb', 64)) > 0, \
+        'durability.segment_mb must be > 0'
+    assert int(dur.get('keep_segments', 2)) >= 0, \
+        'durability.keep_segments must be >= 0 (0 = GC every closed ' \
+        'segment past the horizon)'
     g = ta.get('guard') or {}
     assert str(g.get('nonfinite_policy', 'rollback')) in \
         ('skip', 'rollback', 'abort'), \
@@ -491,6 +514,9 @@ def validate(args: Dict[str, Any]) -> None:
     assert float(lg.get('initial_sigma', 200.0)) \
         >= float(lg.get('min_sigma', 50.0)) > 0, \
         'league sigma bounds need initial_sigma >= min_sigma > 0'
+    assert float(lg.get('rating_flush_seconds', 5.0)) >= 0, \
+        'league.rating_flush_seconds must be >= 0 (0 = epoch-sync ' \
+        'flushes only)'
     for anchor in (lg.get('anchors') or []):
         assert anchor == 'random' or str(anchor).startswith('rulebase'), \
             "league.anchors entries must be 'random' or 'rulebase[-key]' " \

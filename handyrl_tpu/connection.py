@@ -311,6 +311,16 @@ HEARTBEAT_KIND = '__hb__'
 # (inference.EngineClient.rpc does exactly that, via ``is_infer``).
 INFER_KIND = '__infer__'
 
+# Resume-token handshake (docs/large_scale_training.md "Zero-loss training
+# plane"): a reconnecting gather's FIRST frame after a redial is a
+# ``(RESUME_KIND, {gather, run_id, generation})`` RPC. A restarted learner
+# that recognizes the run_id replies ``{'ok': True, 'run_id', 'generation'}``
+# and the gather reattaches in place — resend buffer replayed, nothing
+# respawned. A learner that predates the handshake (or a different run)
+# answers with something else, which the gather treats as "cold respawn"
+# — today's behavior, so mixed-version fleets keep working.
+RESUME_KIND = '__resume__'
+
 # Serving-path trace context rides INSIDE the INFER/admin body dict under
 # this key (docs/observability.md, "Serving-path tracing"): extra dict keys
 # are ignored by peers that predate it, so absent context simply means

@@ -44,6 +44,11 @@ from collections import defaultdict, deque
 from typing import Any, Dict, Optional
 
 from . import telemetry
+from .utils.fs import atomic_write_bytes
+
+# sentinel endpoint for tasks restored from a persisted ledger snapshot:
+# their original endpoints died with the previous learner process
+RESTORED_ENDPOINT = '<restored>'
 
 
 class Backoff:
@@ -83,6 +88,17 @@ class TaskLedger:
     serves ahead of fresh assignments — re-issues must NOT re-increment the
     server's num_episodes/num_results counters, which is exactly why they
     bypass the fresh-task construction path.
+
+    With a :class:`LedgerJournal` attached (``self.journal``) the book is
+    durable: assignments and strandings journal immediately, completions
+    are batched (``flush_journal`` — the server calls it AFTER the
+    episode spool append, so "admitted but completion unjournaled" is the
+    only crash window and spool recovery closes it by cancelling the
+    spooled task_ids). ``restore_state`` repopulates the book from a
+    snapshot+delta replay: restored outstanding tasks re-issue with their
+    ORIGINAL payloads — including the server-stamped ``sample_key`` —
+    ahead of fresh work, unless a reattached gather's replayed upload
+    completes them first.
     """
 
     def __init__(self, deadline: float = 300.0, clock=time.time):
@@ -91,8 +107,11 @@ class TaskLedger:
         self._tasks: Dict[int, tuple] = {}          # tid -> (endpoint, base, expires)
         self._by_endpoint: Dict[Any, set] = defaultdict(set)
         self._reissue: deque = deque()
+        self._restored_reissue: deque = deque()     # (tid, base) from restore
         self._strandings: deque = deque(maxlen=4096)  # (endpoint, reason, t)
         self._next_tid = 0
+        self.journal: Optional['LedgerJournal'] = None
+        self._pending_complete: list = []
         self.stats: Dict[str, int] = {
             'assigned': 0, 'completed': 0, 'duplicates': 0,
             'reissued': 0, 'expired': 0, 'endpoint_failures': 0,
@@ -116,6 +135,8 @@ class TaskLedger:
         self._tasks[tid] = (endpoint, base, self._clock() + self.deadline)
         self._by_endpoint[endpoint].add(tid)
         self.stats['assigned'] += 1
+        if self.journal is not None:
+            self.journal.record('a', tid, base)
         if telemetry.trace_enabled():
             # the trace context is born here: the server-stamped sample_key
             # becomes the trace_id every later hop derives independently
@@ -137,6 +158,11 @@ class TaskLedger:
             if not owners:
                 self._by_endpoint.pop(entry[0], None)
         self.stats['completed'] += 1
+        if self.journal is not None:
+            # deferred: the server flushes AFTER the spool append, so a
+            # kill between admit and flush recovers the episode from the
+            # spool (whose task_id then cancels the restored book entry)
+            self._pending_complete.append(tid)
         return True
 
     def admit(self, items):
@@ -172,6 +198,8 @@ class TaskLedger:
         self._reissue.append(base)
         self._strandings.append((endpoint, reason, self._clock()))
         self.stats['reissued'] += 1
+        if self.journal is not None:
+            self.journal.record('s', tid)
         telemetry.record_event('stranding', str(endpoint), reason=reason)
 
     def fail_endpoint(self, endpoint) -> int:
@@ -194,7 +222,74 @@ class TaskLedger:
         return len(expired)
 
     def next_reissue(self) -> Optional[Dict[str, Any]]:
+        # restored outstanding tasks (a previous learner's in-flight book)
+        # go first; cancel() is the guard — a None return means the task
+        # already closed (replayed upload / spool recovery / reap), so a
+        # restored entry is never issued twice
+        while self._restored_reissue:
+            tid, base = self._restored_reissue.popleft()
+            if self.cancel(tid) is not None:
+                self.stats['reissued'] += 1
+                telemetry.record_event('stranding', RESTORED_ENDPOINT,
+                                       reason='restart')
+                return copy.deepcopy(base)
         return self._reissue.popleft() if self._reissue else None
+
+    def cancel(self, tid) -> Optional[Dict[str, Any]]:
+        """Silently close ``tid`` (no duplicate counting, no re-issue);
+        returns the booked base payload, or None when the book holds no
+        such task. Used by spool recovery: an episode that reached the
+        spool must neither re-issue nor double-count."""
+        entry = self._tasks.pop(tid, None)
+        if entry is None:
+            return None
+        owners = self._by_endpoint.get(entry[0])
+        if owners is not None:
+            owners.discard(tid)
+            if not owners:
+                self._by_endpoint.pop(entry[0], None)
+        if self.journal is not None:
+            self.journal.record('x', tid)
+        return entry[1]
+
+    # -- persistence --
+
+    def flush_journal(self):
+        """Journal the batched completions (called by the server after the
+        spool append that makes those completions safe to forget)."""
+        if self.journal is None or not self._pending_complete:
+            self._pending_complete = []
+            return
+        for tid in self._pending_complete:
+            self.journal.record('c', tid)
+        self._pending_complete = []
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """The durable book: outstanding tasks, the re-issue queue, and
+        the tid high-water mark (epoch-synchronous; deltas journal the
+        between-epoch churn)."""
+        return {
+            'tasks': {tid: entry[1] for tid, entry in self._tasks.items()},
+            'reissue': [copy.deepcopy(b) for b in self._reissue],
+            'next_tid': self._next_tid,
+        }
+
+    def restore_state(self, state: Dict[str, Any]):
+        """Repopulate the book from a :meth:`LedgerJournal.load` replay.
+        Restored tasks are booked under :data:`RESTORED_ENDPOINT` with a
+        fresh deadline and queued for priority re-issue (see
+        ``next_reissue``); the stale-book re-issue queue is carried over
+        verbatim."""
+        now = self._clock()
+        for tid, base in sorted((state.get('tasks') or {}).items()):
+            tid = int(tid)
+            self._tasks[tid] = (RESTORED_ENDPOINT, base,
+                                now + self.deadline)
+            self._by_endpoint[RESTORED_ENDPOINT].add(tid)
+            self._restored_reissue.append((tid, base))
+        self._reissue.extend(state.get('reissue') or ())
+        self._next_tid = max(self._next_tid,
+                             int(state.get('next_tid') or 0))
 
     # -- observability --
 
@@ -217,6 +312,117 @@ class TaskLedger:
         events = list(self._strandings)
         self._strandings.clear()
         return events
+
+
+class LedgerJournal:
+    """Durable storage for the :class:`TaskLedger` book under ``model_dir``.
+
+    Two files, mirroring the checkpoint cadence:
+
+    * ``ledger.snap`` — the full book (outstanding tasks + re-issue queue
+      + tid high-water mark + learner counters), atomically republished at
+      every epoch sync (``snapshot``);
+    * ``ledger.delta.wal`` — CRC-framed msgpack records journaled between
+      snapshots: ``a`` (assign: tid + base payload), ``c`` (complete),
+      ``s`` (strand → re-issue), ``x`` (cancel, no re-issue). One
+      O_APPEND write per record, no per-record fsync (same SIGKILL-vs-
+      machine-crash stance as the episode spool); a torn tail truncates
+      on load.
+
+    msgpack — not JSON — because task payloads carry int-keyed dicts
+    (``model_id``) that a JSON round trip would silently stringify,
+    breaking the byte-identical re-issue contract. ``snapshot`` lands the
+    snap BEFORE truncating the delta journal, and every delta op replays
+    idempotently over a snapshot that already folded it in, so a crash
+    between the two publishes still loads to the same book.
+    """
+
+    SNAP = 'ledger.snap'
+    DELTA = 'ledger.delta.wal'
+
+    def __init__(self, model_dir: str):
+        # late import: connection pulls msgpack/numpy; fault stays
+        # importable without them until a journal is actually built
+        from .connection import pack, unpack
+        from .utils.fs import append_framed_record, open_append, \
+            read_framed_records
+        self._pack, self._unpack = pack, unpack
+        self._append_record = append_framed_record
+        self._open_append = open_append
+        self._read_records = read_framed_records
+        self.snap_path = os.path.join(model_dir, self.SNAP)
+        self.delta_path = os.path.join(model_dir, self.DELTA)
+        self._delta_fd: Optional[int] = None
+
+    def exists(self) -> bool:
+        return (os.path.exists(self.snap_path)
+                or os.path.exists(self.delta_path))
+
+    def record(self, op: str, tid: int, base: Optional[dict] = None):
+        """Append one delta op in a single torn-safe write."""
+        if self._delta_fd is None:
+            os.makedirs(os.path.dirname(self.delta_path) or '.',
+                        exist_ok=True)
+            self._delta_fd = self._open_append(self.delta_path)
+        rec: Dict[str, Any] = {'op': op, 'tid': int(tid)}
+        if base is not None:
+            rec['base'] = base
+        self._append_record(self._delta_fd, self._pack(rec))
+
+    def snapshot(self, state: Dict[str, Any]):
+        """Atomically republish the full book, then truncate the delta
+        journal (snap first: a crash between the two replays stale deltas
+        idempotently over the fresh snap)."""
+        os.makedirs(os.path.dirname(self.snap_path) or '.', exist_ok=True)
+        atomic_write_bytes(self.snap_path, self._pack(state))
+        if self._delta_fd is not None:
+            os.close(self._delta_fd)
+            self._delta_fd = None
+        atomic_write_bytes(self.delta_path, b'')
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        """Replay snapshot + deltas into a restorable book, truncating a
+        torn delta tail in place; None when nothing was ever journaled."""
+        state = None
+        try:
+            with open(self.snap_path, 'rb') as f:
+                state = self._unpack(f.read())
+        except OSError:
+            state = None
+        except Exception:
+            state = None          # corrupt snap: fall back to deltas only
+        if not isinstance(state, dict):
+            state = None
+        tasks = dict((state or {}).get('tasks') or {})
+        reissue = list((state or {}).get('reissue') or ())
+        next_tid = int((state or {}).get('next_tid') or 0)
+        records, valid_bytes, torn = self._read_records(self.delta_path)
+        if torn:
+            os.truncate(self.delta_path, valid_bytes)
+        for payload in records:
+            try:
+                rec = self._unpack(payload)
+                op, tid = rec['op'], int(rec['tid'])
+            except Exception:
+                continue
+            if op == 'a':
+                tasks[tid] = rec.get('base')
+                next_tid = max(next_tid, tid + 1)
+            elif op in ('c', 'x'):
+                tasks.pop(tid, None)
+            elif op == 's':
+                base = tasks.pop(tid, None)
+                if base is not None:
+                    reissue.append(base)
+        if state is None and not records:
+            return None
+        return {'tasks': tasks, 'reissue': reissue, 'next_tid': next_tid,
+                'extra': dict((state or {}).get('extra') or {})}
+
+    def close(self):
+        if self._delta_fd is not None:
+            os.close(self._delta_fd)
+            self._delta_fd = None
 
 
 class SessionLedger:

@@ -1069,6 +1069,20 @@ BUILTIN_ALERTS: Tuple[Dict[str, Any], ...] = (
      'metric': 'gateway_ply_p99_ms', 'kind': 'value',
      'op': '>', 'threshold': 250.0, 'for': 15.0, 'clear_for': 30.0,
      'arm_metric': 'gateway_plies_total'},
+    # durable training plane (docs/large_scale_training.md "Zero-loss
+    # training plane"): a spool whose segment count keeps climbing means
+    # GC has fallen behind the checkpoint consumption horizon (snapshots
+    # stopped landing, or keep_segments is mis-sized) — disk is no longer
+    # bounded; and ANY resend-buffer eviction is permanent episode loss
+    # on a plane that promises zero, so the rate threshold is 0
+    {'name': 'spool_growth',
+     'metric': 'spool_segments', 'kind': 'value',
+     'op': '>', 'threshold': 8.0, 'for': 60.0,
+     'arm_metric': 'spool_bytes_total'},
+    {'name': 'resend_buffer_loss',
+     'metric': 'gather_resend_dropped_total', 'kind': 'rate',
+     'op': '>', 'threshold': 0.0, 'clear_for': 60.0,
+     'arm_metric': 'gather_uploads_total'},
 )
 
 _ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {

@@ -47,16 +47,21 @@ import psutil
 from . import guard as guard_mod
 from . import league as league_mod
 from . import telemetry
+from .connection import RESUME_KIND
+from .connection import pack as conn_pack
+from .connection import unpack as conn_unpack
 from .environment import make_env, prepare_env
-from .fault import FleetController, TaskLedger
+from .fault import FleetController, LedgerJournal, TaskLedger
 from .generation import BatchedEvaluator, BatchedGenerator
 from .model import ModelWrapper
 from .ops.batch import make_batch, select_episode
 from .ops.losses import LossConfig
 from .ops.train_step import TrainState, build_update_step, init_train_state
 from .parallel.mesh import make_mesh, shard_batch
+from .spool import EpisodeSpool
 from .utils.fetch import put_tree
-from .utils.fs import append_jsonl, checksummed_write_bytes, rotate_file
+from .utils.fs import append_jsonl, atomic_write_bytes, \
+    checksummed_write_bytes, rotate_file
 from .worker import WorkerCluster, WorkerServer
 
 _LOG = telemetry.get_logger('train')
@@ -570,8 +575,14 @@ class Trainer:
                     'data_cnt_ema': self.data_cnt_ema}
         payload = serialization.from_bytes(template, raw)
         # build everything before mutating: a parse/convert failure must
-        # leave the live state untouched (resume falls back instead)
-        state = jax.tree_util.tree_map(jnp.asarray, payload['state'])
+        # leave the live state untouched (resume falls back instead).
+        # copy=True is load-bearing: from_bytes leaves are numpy VIEWS into
+        # ``raw``, and the CPU backend zero-copy-aliases aligned numpy
+        # arrays — the compiled update step then DONATES these buffers, so
+        # an aliased leaf means XLA reclaiming memory it does not own
+        # (non-finite garbage, then a segfault once ``raw`` is collected)
+        state = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), payload['state'])
         if isinstance(state, tuple):
             state = TrainState(*state)
         self.state = self.place_state(state)
@@ -1268,6 +1279,32 @@ class Learner:
                              "server() task assignment; the in-process "
                              'batched generator keeps mirror self-play')
 
+        # durable training plane (spool.py EpisodeSpool + fault.LedgerJournal,
+        # docs/large_scale_training.md "Zero-loss training plane"). Remote
+        # only: the in-process front-ends lose nothing a checkpoint does not
+        # already cover, and their records must stay byte-identical.
+        # _load_durable_state publishes the resume token before the entry
+        # listener opens; the spool creates its directory on first append.
+        dur = dict(args.get('durability') or {})
+        self._spool: Optional[EpisodeSpool] = None
+        self._ledger_journal: Optional[LedgerJournal] = None
+        self._restored_ledger: Optional[dict] = None
+        self._durable_restored = False
+        self._spool_horizon = 0          # consumption horizon at last ckpt
+        self._run_generation = 0         # restart generation (resume token)
+        self._token_path = os.path.join(args.get('model_dir', 'models'),
+                                        'run_token.json')
+        self._league_last_flush = time.monotonic()
+        if remote and bool(dur.get('spool', True)):
+            self._spool = EpisodeSpool(
+                args.get('model_dir', 'models'),
+                segment_mb=float(dur.get('segment_mb', 64)),
+                keep_segments=int(dur.get('keep_segments', 2)))
+        if remote and bool(dur.get('ledger_snapshot', True)):
+            self._ledger_journal = LedgerJournal(
+                args.get('model_dir', 'models'))
+        self._load_durable_state()
+
         # the scrape endpoint binds only once everything it reads (trainer,
         # worker front-end) exists — a scrape can land any time after this
         export_port = int(args.get('telemetry_port') or 0)
@@ -1287,6 +1324,121 @@ class Learner:
         if 0 <= self.args['epochs'] <= self.model_epoch:
             return True
         return self._deadline > 0 and time.time() >= self._deadline
+
+    # -- durable training plane ------------------------------------------
+    def _load_durable_state(self):
+        """Restart recovery for the durable training plane: adopt the
+        previous incarnation's resume token (same run_id, generation + 1),
+        replay the persisted ledger book, restore the admission counters,
+        cancel the tasks whose episodes already reached the spool, and
+        feed every spooled episode past the newest checkpoint's
+        consumption horizon back into the buffer — all before the fleet is
+        served a single task."""
+        if self._ledger_journal is None and self._spool is None:
+            return
+        token = None
+        try:
+            with open(self._token_path, 'r') as f:
+                token = json.load(f)
+        except (OSError, ValueError):
+            token = None
+        if isinstance(token, dict) and token.get('run_id'):
+            # keep the dead incarnation's run_id: surviving gathers prove
+            # membership against it in the resume-token handshake (and the
+            # telemetry/trace stream stays one causal run)
+            self.args['run_id'] = str(token['run_id'])
+            telemetry.set_run_id(self.args['run_id'])
+            self._run_generation = int(token.get('generation') or 0) + 1
+
+        state = self._ledger_journal.load() \
+            if self._ledger_journal is not None else None
+        if state is not None:
+            extra = state.get('extra') or {}
+            # counter restore: at least the snapshot values, bounded below
+            # by the sample_key watermark over the persisted book — a
+            # fresh task must NEVER reuse a restored task's sample_key or
+            # the purity contract (episode = f(seed, sample_key, params))
+            # would mint two different episodes under one key
+            g_max = e_max = -1
+            for base in (list((state.get('tasks') or {}).values())
+                         + list(state.get('reissue') or ())):
+                if not isinstance(base, dict) \
+                        or base.get('sample_key') is None:
+                    continue
+                if base.get('role') == 'g':
+                    g_max = max(g_max, int(base['sample_key']))
+                elif base.get('role') == 'e':
+                    e_max = max(e_max, int(base['sample_key']))
+            self.num_episodes = max(int(extra.get('num_episodes') or 0),
+                                    g_max + 1)
+            self.num_results = max(int(extra.get('num_results') or 0),
+                                   e_max + 1)
+            self.num_returned_episodes = int(
+                extra.get('num_returned_episodes') or 0)
+            self._spool_horizon = int(extra.get('spool_horizon') or 0)
+            self._durable_restored = True
+            print('durable plane: restored ledger book (%d outstanding, '
+                  '%d pending re-issue, counters g=%d e=%d returned=%d)'
+                  % (len(state.get('tasks') or {}),
+                     len(state.get('reissue') or ()), self.num_episodes,
+                     self.num_results, self.num_returned_episodes))
+
+        if self._spool is not None:
+            recovered = self._spool.recover(self._spool_horizon, conn_unpack)
+            if recovered:
+                # an episode that reached the spool must neither re-issue
+                # nor double-count: drop its task_id from the restored
+                # book before the ledger ever sees it (this closes the
+                # only crash window — admitted but completion unflushed)
+                tasks = (state or {}).get('tasks')
+                for rec in recovered:
+                    tid = ((rec.get('episode') or {}).get('args')
+                           or {}).get('task_id')
+                    if tasks is not None and tid is not None:
+                        tasks.pop(tid, None)
+                self.feed_episodes(
+                    [rec.get('episode') for rec in recovered],
+                    recovered=True)
+                self._durable_restored = True
+                print('durable plane: recovered %d spooled episode(s) '
+                      'past horizon %d (zero admitted episodes lost)'
+                      % (len(recovered), self._spool_horizon))
+        self._restored_ledger = state
+        if self._durable_restored:
+            # the trainer resumes mid-stream: it must not re-wait a full
+            # fresh minimum_episodes warmup on top of the restored buffer
+            self.trainer.seen_episodes = self.num_returned_episodes
+
+        # publish THIS incarnation's resume token now — before run() opens
+        # the entry listener — so every gather (fresh or redialing) sees it
+        # in the merged entry config. The NEXT restart adopts the run_id
+        # and bumps the generation; reattaching gathers prove membership
+        # against it (the RESUME_KIND branch in server()).
+        os.makedirs(self.args.get('model_dir', 'models'), exist_ok=True)
+        atomic_write_bytes(self._token_path, (json.dumps(
+            {'run_id': str(self.args.get('run_id')),
+             'generation': self._run_generation}) + '\n').encode('utf-8'))
+        self.args['resume_token'] = {
+            'run_id': str(self.args.get('run_id')),
+            'generation': self._run_generation}
+
+    def _sync_durable_state(self):
+        """Epoch-sync the durable plane (rides every checkpoint write):
+        republish the ledger snapshot — folding the delta journal — and
+        GC spool segments behind the new consumption horizon."""
+        if self.ledger is not None and self._ledger_journal is not None:
+            self.ledger.flush_journal()
+            state = self.ledger.snapshot_state()
+            state['extra'] = {
+                'num_episodes': self.num_episodes,
+                'num_results': self.num_results,
+                'num_returned_episodes': self.num_returned_episodes,
+                'spool_horizon': self.num_returned_episodes,
+            }
+            self._ledger_journal.snapshot(state)
+        if self._spool is not None:
+            self._spool_horizon = self.num_returned_episodes
+            self._spool.gc(self._spool_horizon)
 
     # -- checkpoints ------------------------------------------------------
     def model_path(self, model_id: int) -> str:
@@ -1345,6 +1497,10 @@ class Learner:
         # pin must be pinned by the time the GC pass reads the manifest
         self._publish_checkpoint(steps)
         self._gc_checkpoints()
+        # durable plane rides the checkpoint cadence: the ledger snapshot
+        # and the spool GC horizon must describe a state a restart can
+        # actually resume from, i.e. one with a durable checkpoint
+        self._sync_durable_state()
 
     def _registry_root(self) -> str:
         srv = self.args.get('serving') or {}
@@ -1650,7 +1806,13 @@ class Learner:
                  guard_mod.PREEMPT_EXIT_CODE), flush=True)
 
     # -- accounting -------------------------------------------------------
-    def feed_episodes(self, episodes: List[Optional[dict]]):
+    def feed_episodes(self, episodes: List[Optional[dict]],
+                      recovered: bool = False):
+        """``recovered=True`` marks a restart replay from the episode
+        spool: the episodes were already WAL'd and their ratings already
+        journaled, so they skip the spool append and the league booking —
+        everything else (guard screen, generation stats, the returned
+        counter, the buffer) treats them exactly like a fresh upload."""
         if self._check_episodes:
             # ingest guard: one poisoned actor (NaN observations/rewards)
             # must not contaminate every future batch — drop and count
@@ -1669,6 +1831,14 @@ class Learner:
         for episode in episodes:
             if episode is None:
                 continue
+            if self._spool is not None and not recovered:
+                # WAL before ANY accounting: a SIGKILL past this line
+                # replays the episode on restart; before it, the episode
+                # never existed (its ledger task re-issues byte-identically)
+                self._spool.append(
+                    self.num_returned_episodes,
+                    conn_pack({'idx': self.num_returned_episodes,
+                               'episode': episode}))
             if episode.get('record_version'):
                 # device-actor records that follow the device rng contract
                 # instead of the host byte contract arrive stamped; the
@@ -1688,7 +1858,8 @@ class Learner:
                 n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
                 self.generation_results[model_id] = (n + 1, r + outcome,
                                                      r2 + outcome ** 2)
-            self._league_observe_episode(episode)
+            if not recovered:
+                self._league_observe_episode(episode)
             self.num_returned_episodes += 1
             if self.num_returned_episodes % 100 == 0:
                 # complete line at debug level, not a bare dot stream that
@@ -1841,6 +2012,7 @@ class Learner:
         self._league_ratings.record(member, (float(outcome) + 1.0) / 2.0)
         self._league_sampled[member] = self._league_sampled.get(member, 0) + 1
         telemetry.counter('league_games_total').inc()
+        self._league_flush_maybe()
 
     def _league_observe_result(self, result: dict):
         """Book a league rating match ('e' slice): the evaluated seat's
@@ -1859,6 +2031,27 @@ class Learner:
             return
         self._league_ratings.record(member, (float(res) + 1.0) / 2.0)
         telemetry.counter('league_games_total').inc()
+        self._league_flush_maybe()
+
+    def _league_flush_maybe(self):
+        """Write the rating journal through shortly after an outcome lands
+        (league.rating_flush_seconds min-interval): a hard-killed learner
+        loses at most that window of ratings instead of everything since
+        the last epoch sync. The journal write is already atomic
+        (RatingBook.save -> atomic_write_bytes), so a kill mid-flush
+        leaves the previous journal intact."""
+        if getattr(self, '_league_ratings', None) is None \
+                or not self._league_journal:
+            return
+        interval = float((self.args.get('league') or {})
+                         .get('rating_flush_seconds', 5.0))
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._league_last_flush < interval:
+            return
+        self._league_last_flush = now
+        self._league_ratings.save(self._league_journal)
 
     def _print_league_stats(self):
         if getattr(self, '_league', None) is None \
@@ -2664,6 +2857,19 @@ class Learner:
         ft = self.args.get('fault_tolerance') or {}
         ledger = self.ledger = TaskLedger(
             deadline=float(ft.get('task_deadline', 300.0)))
+        if self._restored_ledger is not None:
+            # previous incarnation's in-flight book: restored tasks
+            # re-issue with their original sample_keys ahead of fresh work
+            ledger.restore_state(self._restored_ledger)
+            self._restored_ledger = None
+        if self._ledger_journal is not None:
+            ledger.journal = self._ledger_journal
+        if self._durable_restored:
+            # restored counters already crossed earlier epoch thresholds —
+            # the dead incarnation consumed them (its checkpoints exist);
+            # drain the cadence so they are not re-fired as empty epochs
+            while cadence.due(self.num_returned_episodes):
+                pass
         fleet = self.fleet = FleetController(
             degrade_after=int(ft.get('host_degrade_after', 1)),
             quarantine_after=int(ft.get('host_quarantine_after', 3)),
@@ -2851,11 +3057,37 @@ class Learner:
 
             elif req == 'episode':
                 self.feed_episodes(ledger.admit(data))
+                # completions flush AFTER the spool append above: an
+                # admitted-but-unflushed kill window recovers from the
+                # spool (whose task_ids cancel the restored book entries)
+                ledger.flush_journal()
                 send_data = [None] * len(data)
 
             elif req == 'result':
                 self.feed_results(ledger.admit(data))
+                ledger.flush_journal()
                 send_data = [None] * len(data)
+
+            elif req == RESUME_KIND:
+                # resume-token handshake: a surviving gather redialed a
+                # restarted learner. run_id match => reattach in place
+                # (its resend buffer replays as ordinary duplicate-screened
+                # uploads); mismatch => the gather cold-respawns, exactly
+                # today's behavior for a genuinely different run
+                for tok in data:
+                    tok = tok if isinstance(tok, dict) else {}
+                    ok = (str(tok.get('run_id'))
+                          == str(self.args.get('run_id')))
+                    if ok and int(tok.get('generation', -1)) \
+                            != self._run_generation:
+                        telemetry.counter('gather_reattach_total').inc()
+                        _LOG.info(
+                            'gather %s reattached across a learner restart '
+                            '(generation %s -> %d)', tok.get('gather'),
+                            tok.get('generation'), self._run_generation)
+                    send_data.append(
+                        {'ok': ok, 'run_id': str(self.args.get('run_id')),
+                         'generation': self._run_generation})
 
             elif req == 'model':
                 for model_id in data:
@@ -2960,6 +3192,10 @@ class Learner:
         # (tests, notebooks) must not leave the retrace sentinel armed for
         # whatever jits next in this process
         telemetry.clear_steady_state()
+        if self._spool is not None:
+            self._spool.close()
+        if self._ledger_journal is not None:
+            self._ledger_journal.close()
         self.trainer.shutdown()
         if self._trainer_thread is not None:
             self._trainer_thread.join(timeout=300)

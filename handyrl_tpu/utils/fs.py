@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import tempfile
 import time
 import zlib
@@ -151,18 +152,84 @@ def rotate_file(path: str, max_mb: float) -> bool:
     return True
 
 
-def append_jsonl(path: str, record: dict):
+def append_jsonl(path: str, record: dict, fsync: bool = True):
     """Append ``record`` to a JSONL file append-safely.
 
     The whole encoded line (payload + newline) goes down in ONE
-    ``os.write`` on an ``O_APPEND`` descriptor and is fsynced before the
-    descriptor closes — so a learner killed mid-epoch leaves either the
-    complete line or no line, never a torn half-line that breaks every
-    downstream JSONL parse of the metrics file."""
+    ``os.write`` on an ``O_APPEND`` descriptor and (by default) is fsynced
+    before the descriptor closes — so a learner killed mid-epoch leaves
+    either the complete line or no line, never a torn half-line that
+    breaks every downstream JSONL parse of the metrics file.
+    ``fsync=False`` keeps the single-write torn-line guarantee against a
+    process SIGKILL but skips the disk barrier — right for hot-path
+    journals (the ledger delta journal) whose machine-crash story is
+    already covered by the epoch snapshot."""
     line = (json.dumps(record) + '\n').encode('utf-8')
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     try:
         os.write(fd, line)
-        os.fsync(fd)
+        if fsync:
+            os.fsync(fd)
     finally:
         os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# CRC-framed binary records (the episode-spool WAL vocabulary)
+#
+# One record = MAGIC(4) + length(4, big-endian) + crc32(4, big-endian) +
+# payload. The frame is deliberately chunk-shaped: the same framing serves
+# any future streaming-ingest journal (a trajectory chunk is just a
+# payload). Appends go down in ONE os.write on an O_APPEND descriptor, so
+# a SIGKILL leaves at worst one torn record at the tail — which
+# read_framed_records detects (bad magic / short header / short payload /
+# crc mismatch) and reports so recovery can truncate it cleanly.
+
+RECORD_MAGIC = b'HRLW'
+_RECORD_HEADER = struct.Struct('>II')   # payload length, crc32
+
+
+def frame_record(payload: bytes) -> bytes:
+    """One self-verifying framed record for ``payload``."""
+    return (RECORD_MAGIC
+            + _RECORD_HEADER.pack(len(payload),
+                                  zlib.crc32(payload) & 0xffffffff)
+            + payload)
+
+
+def open_append(path: str) -> int:
+    """An O_APPEND descriptor for a record file (create if missing)."""
+    return os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+
+
+def append_framed_record(fd: int, payload: bytes) -> int:
+    """Append one framed record in a single write; returns bytes written."""
+    frame = frame_record(payload)
+    os.write(fd, frame)
+    return len(frame)
+
+
+def read_framed_records(path: str):
+    """Decode a framed-record file tolerantly: ``(records, valid_bytes,
+    torn)`` where ``records`` is the list of verified payloads,
+    ``valid_bytes`` is the offset of the first byte past the last GOOD
+    record, and ``torn`` is True when trailing bytes past that offset
+    failed framing/CRC (a SIGKILL mid-append) — the caller truncates the
+    file to ``valid_bytes`` to restore a clean tail."""
+    try:
+        with open(path, 'rb') as f:
+            data = f.read()
+    except OSError:
+        return [], 0, False
+    records, offset, frame_len = [], 0, len(RECORD_MAGIC) + _RECORD_HEADER.size
+    while offset + frame_len <= len(data):
+        if data[offset:offset + len(RECORD_MAGIC)] != RECORD_MAGIC:
+            break
+        size, crc = _RECORD_HEADER.unpack_from(data, offset + len(RECORD_MAGIC))
+        start = offset + frame_len
+        payload = data[start:start + size]
+        if len(payload) < size or (zlib.crc32(payload) & 0xffffffff) != crc:
+            break
+        records.append(payload)
+        offset = start + size
+    return records, offset, offset < len(data)

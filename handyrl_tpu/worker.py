@@ -41,7 +41,7 @@ from socket import gethostname
 from typing import Any, Dict, Optional
 
 from . import telemetry
-from .connection import (HEARTBEAT_KIND, INFER_KIND, Hub,
+from .connection import (HEARTBEAT_KIND, INFER_KIND, RESUME_KIND, Hub,
                          accept_socket_connections,
                          connect_socket_connection, force_cpu_backend,
                          send_recv, spawn_pipe_workers)
@@ -300,7 +300,16 @@ class Gather:
         self._backoff_max = float(ft.get('reconnect_max_delay', 30.0))
         self._max_tries = int(ft.get('reconnect_max_tries', 30))
         self._resend_max = int(ft.get('resend_buffer', 256))
-        self.stats = {'reconnects': 0, 'dropped_uploads': 0}
+        # resume token stamped by a durable learner (train.py publishes it in
+        # the merged entry config): presented on every redial so a RESTARTED
+        # learner recognizes this gather and it rides through without a
+        # respawn — an unrecognized run_id forces the cold path instead
+        self._resume_token = dict(args.get('resume_token') or {})
+        self.stats = {'reconnects': 0, 'dropped_uploads': 0, 'reattaches': 0}
+        self._m_resend_dropped = telemetry.counter(
+            'gather_resend_dropped_total', gather=gid)
+        self._m_reattach = telemetry.counter('gather_reattach_total',
+                                             gather=gid)
         if server_conn is None and reconnect is not None:
             server_conn = self._dial()   # child-side dial (respawn-friendly)
         self.server = server_conn
@@ -425,6 +434,45 @@ class Gather:
                 last_err = e
                 continue
             conn.sock.settimeout(self._rpc_timeout)
+            if self._resume_token:
+                # resume-token handshake (durable learner): prove membership
+                # before committing the link. A RESTARTED learner with the
+                # same run_id answers ok + its new generation — this gather
+                # reattaches in place and its resend buffer replays as
+                # ordinary duplicate-screened uploads. A different run_id
+                # (or a reply this build cannot read) means the fleet we
+                # belonged to is gone: fail hard so the supervisor
+                # cold-respawns against the new run.
+                try:
+                    reply = send_recv(conn, (RESUME_KIND, dict(
+                        self._resume_token, gather=self.gather_id)))
+                except _CONN_ERRORS as e:
+                    last_err = e
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    continue
+                if not (isinstance(reply, dict) and reply.get('ok')):
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                    raise ConnectionError(
+                        'gather %d: learner rejected the resume token '
+                        '(run over or replaced); cold respawn required'
+                        % self.gather_id)
+                gen = int(reply.get('generation',
+                                    self._resume_token.get('generation', 0)))
+                if gen != int(self._resume_token.get('generation', 0)):
+                    # the learner restarted while we were severed: this
+                    # redial is a zero-respawn reattach, not a mere blip
+                    self._resume_token['generation'] = gen
+                    self.stats['reattaches'] += 1
+                    self._m_reattach.inc()
+                    _LOG.warning(
+                        'gather %d: reattached across a learner restart '
+                        '(generation %d)', self.gather_id, gen)
             self.server = conn
             self.stats['reconnects'] += 1
             self._m_reconnects.inc()
@@ -488,6 +536,18 @@ class Gather:
             self._upload_count -= 1
             self.stats['dropped_uploads'] += 1
             self._m_dropped.inc()
+            self._m_resend_dropped.inc()
+            if self.stats['dropped_uploads'] == 1 \
+                    or self.stats['dropped_uploads'] % 50 == 0:
+                # loud, throttled: evicted uploads are PERMANENT episode
+                # loss — the alert catalog watches the counter, this line
+                # lands in the FlightRecorder ring for the post-mortem
+                _LOG.warning(
+                    'gather %d: resend buffer full (%d); dropped a %r '
+                    'upload (%d dropped so far) — raise '
+                    'fault_tolerance.resend_buffer or shorten outages',
+                    self.gather_id, self._resend_max, biggest,
+                    self.stats['dropped_uploads'])
         if self._upload_count >= self.block:
             for kind in list(self._upload_box):
                 self._server_rpc((kind, self._upload_box[kind]))
