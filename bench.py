@@ -613,6 +613,91 @@ def run_ingest(probe: dict):
         spool_off_bps = max(off for _, off in sp_rounds)
         spool_overhead = (100.0 * (1.0 - spool_on_bps / spool_off_bps)
                           if spool_off_bps else 0.0)
+        # streaming-on vs streaming-off pair: with the `streaming:` block
+        # enabled, episodes arrive as fixed-T window chunks and the
+        # learner-side ChunkAssembler folds them back together (decode per
+        # chunk, finiteness screen, return fill + canonical recompress at
+        # completion). In the real learner ALL admission work runs on the
+        # SERVER thread, concurrent with the batcher threads — and the
+        # whole-episode path is not free there either (feed_episodes
+        # guard-screens every upload, a full decode). So both legs model
+        # the topology: a feeder thread admits the full buffer exactly
+        # once per leg, paced by the build counter — the off-leg screening
+        # whole episodes (guard.episode_is_finite, the real admission
+        # cost), the on-leg folding the chunked buffer through a fresh
+        # assembler — and builds/sec measures the DELTA streaming adds to
+        # the shared host (chunk bookkeeping + return fill + canonical
+        # recompress; on a multi-core learner the bz2 legs overlap, GIL
+        # released). Worker-side chunking is prepared untimed (that cost
+        # lives on the generation host). Same alternating best-of-5
+        # discipline, acceptance <= 2% (`chunk_overhead_pct` in
+        # scripts/perf_gate.py 'bench-ingest')
+        from handyrl_tpu import guard as guard_mod
+        from handyrl_tpu.generation import build_chunk
+        from handyrl_tpu.ops.batch import decompress_moments
+        from handyrl_tpu.streaming import ChunkAssembler
+        stream_args = dict(args)
+        stream_args.update(
+            gamma=0.8,
+            streaming={'enabled': True, 'chunk_steps': 32})
+        all_chunks = []
+        for i, ep in enumerate(episodes):
+            moments = decompress_moments(ep['moment'])
+            for m in moments:
+                m['return'] = {p: None for p in m['return']}
+            gen_args = dict(ep['args'], sample_key=i, task_id=i)
+            cs = 32
+            for ci, base in enumerate(range(0, len(moments), cs)):
+                window = moments[base:base + cs]
+                final = base + cs >= len(moments)
+                all_chunks.append(build_chunk(
+                    gen_args, ci, base, window, stream_args,
+                    final=final, outcome=ep['outcome'] if final else None))
+
+        def paced_leg(units, admit):
+            """One measured leg with a feeder thread admitting ``units``
+            once, spread evenly across the leg's builds (the server-thread
+            topology). Returns the measured builds/sec."""
+            stride = max(1, builds_per_leg // len(units))
+            built = [0]
+            cond = threading.Condition()
+
+            def feeder():
+                for i, unit in enumerate(units):
+                    with cond:
+                        while built[0] < i * stride:
+                            if not cond.wait(timeout=30.0):
+                                return     # leg abandoned
+                    admit(unit)
+
+            feeder_th = threading.Thread(target=feeder, daemon=True)
+            feeder_th.start()
+
+            def paced_build(sel, a, timer=None, cache=None):
+                with cond:
+                    built[0] += 1
+                    cond.notify_all()
+                return make_batch(sel, a, timer=timer, cache=cache)
+
+            bps = _measure_ingest(paced_build, episodes, args,
+                                  n_batches * 5)
+            with cond:
+                built[0] += builds_per_leg     # release any waiting folds
+                cond.notify_all()
+            feeder_th.join(timeout=60)
+            return bps
+
+        st_rounds = []
+        for _ in range(5):
+            asm = ChunkAssembler(stream_args)
+            st_on = paced_leg(all_chunks, asm.add)
+            st_off = paced_leg(episodes, guard_mod.episode_is_finite)
+            st_rounds.append((st_on, st_off))
+        streaming_on_bps = max(on for on, _ in st_rounds)
+        streaming_off_bps = max(off for _, off in st_rounds)
+        chunk_overhead = (100.0 * (1.0 - streaming_on_bps /
+                                   streaming_off_bps)
+                          if streaming_off_bps else 0.0)
 
     default_geom = (B == 128 and T == 16)
     # stage keys in the canonical telemetry order (telemetry.INGEST_STAGES
@@ -643,6 +728,9 @@ def run_ingest(probe: dict):
          spool_on_batches_per_sec=round(spool_on_bps, 2),
          spool_off_batches_per_sec=round(spool_off_bps, 2),
          spool_overhead_pct=round(spool_overhead, 2),
+         streaming_on_batches_per_sec=round(streaming_on_bps, 2),
+         streaming_off_batches_per_sec=round(streaming_off_bps, 2),
+         chunk_overhead_pct=round(chunk_overhead, 2),
          geometry=('headline' if default_geom else 'dryrun'))
 
 

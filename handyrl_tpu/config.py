@@ -111,6 +111,23 @@ TRAIN_DEFAULTS: Dict[str, Any] = {
         'ledger_snapshot': True,  # persist the TaskLedger book (ledger.snap at each epoch + ledger.delta.wal between), so a restarted learner re-issues stranded tasks with their ORIGINAL sample_keys
     },
 
+    # streaming partial-episode ingest (streaming.py ChunkAssembler,
+    # docs/large_scale_training.md "Streaming ingest"): workers flush
+    # fixed-T window chunks of in-flight episodes through the upload path
+    # instead of holding completed episodes, so long games stop adding
+    # full-episode latency to policy lag. Default off; off is byte-identical
+    # to the whole-episode path. Chunk boundaries are a pure function of
+    # (seed, sample_key, chunk_steps), so re-issued attempts regenerate
+    # identical chunks and the assembler's duplicate screen merges them.
+    'streaming': {
+        'enabled': False,         # flush in-flight episodes as fixed-T chunks (remote 'g' tasks); the final chunk carries the outcome
+        'chunk_steps': 32,        # plies per flushed chunk (T); must be a multiple of compress_steps so chunk-local bz2 blocks land on the whole-episode block grid
+        'staleness_half_life': 0.0,  # seconds after which a sampled chunk's selection weight halves (per-chunk recv age); 0 = no staleness-aware reselection (selection byte-identical to whole-episode draws)
+        'max_reselect': 4,        # bounded re-draws before a stale window is accepted regardless (keeps selection O(1) under backlog)
+        'target_clip': 0.0,       # IMPACT-style clipped target network: V-trace rhos computed against a lagged target policy, clipped at this ceiling; 0 = off (independent of streaming.enabled)
+        'target_sync_epochs': 1,  # epochs between target-network refreshes from the live params (target_clip > 0)
+    },
+
     # per-host batched inference service for the distributed actor fleet
     # (inference.py, docs/large_scale_training.md "Actor inference service"):
     # workers become pure env-steppers; one engine per host coalesces their
@@ -350,6 +367,25 @@ def validate(args: Dict[str, Any]) -> None:
     assert int(dur.get('keep_segments', 2)) >= 0, \
         'durability.keep_segments must be >= 0 (0 = GC every closed ' \
         'segment past the horizon)'
+    stm = ta.get('streaming') or {}
+    assert isinstance(stm, dict), \
+        'streaming must be a block (enabled / chunk_steps / ' \
+        'staleness_half_life / max_reselect / target_clip / ' \
+        'target_sync_epochs)'
+    assert int(stm.get('chunk_steps', 32)) >= 1, \
+        'streaming.chunk_steps must be >= 1'
+    assert int(stm.get('chunk_steps', 32)) % int(ta['compress_steps']) == 0, \
+        'streaming.chunk_steps must be a multiple of compress_steps so ' \
+        'chunk-local bz2 blocks align with the whole-episode block grid ' \
+        '(byte-identical reassembly)'
+    assert float(stm.get('staleness_half_life', 0.0)) >= 0, \
+        'streaming.staleness_half_life must be >= 0 (0 = off)'
+    assert int(stm.get('max_reselect', 4)) >= 1, \
+        'streaming.max_reselect must be >= 1'
+    assert float(stm.get('target_clip', 0.0)) >= 0, \
+        'streaming.target_clip must be >= 0 (0 = no target network)'
+    assert int(stm.get('target_sync_epochs', 1)) >= 1, \
+        'streaming.target_sync_epochs must be >= 1'
     g = ta.get('guard') or {}
     assert str(g.get('nonfinite_policy', 'rollback')) in \
         ('skip', 'rollback', 'abort'), \

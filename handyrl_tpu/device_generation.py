@@ -32,8 +32,8 @@ import numpy as np
 
 from . import telemetry
 from .generation import (Generator, _blank_moment, _finalize_episode,
-                         bucketed_inference, masked_sample, pad_to_bucket,
-                         sample_seed, seed_env_rng)
+                         bucketed_inference, build_chunk, masked_sample,
+                         pad_to_bucket, sample_seed, seed_env_rng)
 from .ops.batch import compress_moments
 from .utils.tree import map_structure
 
@@ -733,6 +733,12 @@ class DeviceActorEngine:
         self.recurrent = hasattr(host_env.net(), 'init_hidden')
         self.record_mode = resolve_record_mode(env_mod, self.recurrent,
                                                str(record_mode or ''))
+        # streaming ingest sink (set by DeviceActorGather when the
+        # streaming: block is on): lanes in 'device' record mode flush
+        # fixed-T windows through it mid-block instead of holding the
+        # finished episode. 'strict' lanes never stream — their byte
+        # contract is only proven by the END-of-episode host replay.
+        self.emit = None
         self.blocks = 0
         self._built = None          # wrapper the program was traced from
         self._rollout = None
@@ -1030,6 +1036,21 @@ class DeviceActorEngine:
         slot_d = jnp.asarray(seat_slot)
         mode_d = jnp.asarray(seat_mode)
 
+        # streaming ingest: per-lane window buffers, flushed through
+        # self.emit as each fixed-T window fills (device record mode only:
+        # these records are attempt-scoped, so every chunk is stamped and
+        # keyed by task_id learner-side)
+        stream = None
+        if self.emit is not None and not strict \
+                and (self.args.get('streaming') or {}).get('enabled'):
+            stream = {
+                'T': int((self.args.get('streaming') or {})
+                         .get('chunk_steps', 32)),
+                'lanes': [dict(moments=[], flushed=0, chunk=0, done=False)
+                          if cls['kind'] == 'episode' else None
+                          for cls in plan],
+            }
+
         chunks, plies_run = [], 0
         n_chunks_cap = max(2, -(-self.max_steps // self.chunk_steps) + 2)
         for _ in range(n_chunks_cap):
@@ -1043,8 +1064,20 @@ class DeviceActorEngine:
             self._m_chunk.observe(time.perf_counter() - t0)
             chunks.append(rec)
             plies_run += int(rec['live'].sum())
+            if stream is not None:
+                self._stream_lanes(plan, rec, stream)
             if not (rec['live'][-1] & ~rec['done'][-1]).any():
                 break
+        if stream is not None:
+            # block cap reached: flush the unfinished lanes' partial tails
+            # as non-final windows (the gather's clean-exit flush ships
+            # them) — the learner trains on the exposed prefix while the
+            # deadline re-issue regenerates the episode under a new task
+            for i, st in enumerate(stream['lanes']):
+                if st is None or st['done'] \
+                        or len(st['moments']) <= st['flushed']:
+                    continue
+                self._emit_lane_chunk(plan[i], st, final=False)
         self._m_plies.inc(plies_run)
         scheduled = len(chunks) * self.chunk_steps * max(1, len(plan))
         self._m_fill.set(plies_run / max(1, scheduled))
@@ -1054,6 +1087,11 @@ class DeviceActorEngine:
 
         uploads = []
         for i, cls in enumerate(plan):
+            if stream is not None and stream['lanes'][i] is not None:
+                # every window of this lane (final chunk included, when it
+                # finished) already rode the emit sink; an unfinished lane
+                # re-issues on deadline like a failed one
+                continue
             ks = np.nonzero(rec['live'][:, i])[0]
             finished = len(ks) > 0 and bool(rec['done'][ks[-1], i])
             payload = None
@@ -1073,6 +1111,62 @@ class DeviceActorEngine:
         if self.blocks == 1:
             telemetry.mark_steady_state(note='device actor warmup complete')
         return uploads, deferred
+
+    # -- streaming ----------------------------------------------------------
+
+    def _stream_lanes(self, plan, rec, stream):
+        """Fold one dispatch's records into the per-lane chunk streams,
+        flushing every filled fixed-T window through the emit sink. A lane
+        whose episode terminated emits its final chunk (tail + outcome)
+        and stops accumulating."""
+        players = list(range(self.num_players))
+        for i, cls in enumerate(plan):
+            st = stream['lanes'][i]
+            if st is None or st['done']:
+                continue
+            try:
+                ks = np.nonzero(rec['live'][:, i])[0]
+                for k in ks:
+                    if self.simultaneous:
+                        st['moments'].append(
+                            self._lane_moment_simultaneous(
+                                rec, k, i, players))
+                    else:
+                        st['moments'].append(
+                            self._lane_moment_turn_based(rec, k, i, players))
+                while len(st['moments']) - st['flushed'] >= stream['T']:
+                    self._emit_lane_chunk(cls, st, final=False,
+                                          upto=st['flushed'] + stream['T'])
+                if len(ks) > 0 and bool(rec['done'][ks[-1], i]):
+                    outcome = {p: float(rec['outcome'][ks[-1], i, p])
+                               for p in players}
+                    self._emit_lane_chunk(cls, st, final=True,
+                                          outcome=outcome)
+                    st['done'] = True
+                    telemetry.counter('episodes_generated_total').inc()
+                    telemetry.counter('generation_steps_total').inc(
+                        len(st['moments']))
+                    self._m_episodes.inc()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                # stop streaming this lane; the already-emitted prefix
+                # stays usable and the deadline re-issues the task
+                st['done'] = True
+                telemetry.counter('worker_task_failures_total').inc()
+
+    def _emit_lane_chunk(self, cls, st, final, outcome=None, upto=None):
+        """Ship one window of a streamed lane, stamped ``record_version``
+        (device records carry no host byte contract; the assembler keys
+        stamped streams by task_id so attempts never merge)."""
+        upto = len(st['moments']) if upto is None else upto
+        window = st['moments'][st['flushed']:upto]
+        chunk = build_chunk(cls['task'], st['chunk'], st['flushed'], window,
+                            self.args, final=final, outcome=outcome)
+        chunk['record_version'] = 1
+        st['flushed'] = upto
+        st['chunk'] += 1
+        self.emit(chunk)
 
     # -- splicing -----------------------------------------------------------
 

@@ -111,7 +111,15 @@ class TaskLedger:
         self._strandings: deque = deque(maxlen=4096)  # (endpoint, reason, t)
         self._next_tid = 0
         self.journal: Optional['LedgerJournal'] = None
-        self._pending_complete: list = []
+        self._pending_complete: list = []   # ('c', tid) / ('q', key) ops
+        # streaming ingest (streaming.py): per-assembly chunk-index dedupe
+        # book, keyed like the assembler (sample_key for host-contract
+        # streams, task_id for device ones). Closed keys move to a bounded
+        # ring so resend-buffer replays of a finished episode's chunks
+        # still screen as duplicates.
+        self._chunks: Dict[Any, set] = {}
+        self._closed_chunk_keys: 'deque' = deque(maxlen=4096)
+        self._closed_chunk_set: set = set()
         self.stats: Dict[str, int] = {
             'assigned': 0, 'completed': 0, 'duplicates': 0,
             'reissued': 0, 'expired': 0, 'endpoint_failures': 0,
@@ -162,7 +170,7 @@ class TaskLedger:
             # deferred: the server flushes AFTER the spool append, so a
             # kill between admit and flush recovers the episode from the
             # spool (whose task_id then cancels the restored book entry)
-            self._pending_complete.append(tid)
+            self._pending_complete.append(('c', tid))
         return True
 
     def admit(self, items):
@@ -185,6 +193,100 @@ class TaskLedger:
                         telemetry.trace_event('ingest', trace_id=ttid,
                                               task_id=tid)
         return out
+
+    def admit_chunks(self, items):
+        """Duplicate-screen a streamed chunk batch (streaming.py).
+
+        Unlike :meth:`admit`, a chunk does NOT close its task — the task
+        completes when the assembler reports the episode whole
+        (:meth:`complete_chunked`). The screen is per (assembly key,
+        chunk_index): re-issued attempts of a pure host-contract task
+        share the sample_key, so their regenerated chunks merge here
+        instead of double-counting; chunks of an already-closed assembly
+        (resend-buffer replays after completion) drop like any duplicate
+        upload. Accepted deliveries journal as ``p`` ops, so a restarted
+        learner's screen picks up exactly where the book left off."""
+        from .streaming import chunk_key
+        out = []
+        tracing = telemetry.trace_enabled()
+        for chunk in items:
+            if chunk is None:
+                continue
+            key = chunk_key(chunk)
+            ci = int(chunk.get('chunk', 0))
+            if key is None or key in self._closed_chunk_set \
+                    or ci in self._chunks.get(key, ()):
+                self.stats['duplicates'] += 1
+                telemetry.counter('chunk_duplicates_total').inc()
+                continue
+            self._chunks.setdefault(key, set()).add(ci)
+            if self.journal is not None:
+                # deferred like completions: the 'p' op must land AFTER
+                # the spool append, or a kill between them would leave a
+                # delivery journaled whose bytes no WAL replay can produce
+                self._pending_complete.append(
+                    ('p', (int((chunk.get('args') or {})
+                               .get('task_id') or -1), list(key), ci)))
+            telemetry.counter('chunks_ingested_total').inc()
+            out.append(chunk)
+            if tracing:
+                args = chunk.get('args') or {}
+                ttid = telemetry.episode_trace_id(args)
+                if ttid:
+                    telemetry.trace_event('ingest', trace_id=ttid,
+                                          task_id=args.get('task_id'),
+                                          chunk=ci)
+        return out
+
+    def seed_chunk(self, key, ci: int):
+        """Re-seed the dedupe book during spool recovery (the replayed
+        chunks were already journaled; no new delta op)."""
+        self._chunks.setdefault(key, set()).add(int(ci))
+
+    def complete_chunked(self, key, tid) -> bool:
+        """Close the book on a fully-reassembled streamed episode: the
+        owning task completes (the final chunk's tid, or — when that
+        attempt's book entry already closed — whichever open task still
+        carries the assembly's sample_key), and the assembly key moves to
+        the closed ring so stragglers screen as duplicates."""
+        done = tid is not None and self.complete(tid)
+        if not done and isinstance(key, (list, tuple)) \
+                and len(key) == 2 and key[0] == 'k':
+            for other_tid, (_ep, base, _exp) in list(self._tasks.items()):
+                if isinstance(base, dict) \
+                        and base.get('sample_key') == key[1] \
+                        and base.get('role') == 'g':
+                    done = self.complete(other_tid)
+                    break
+        k = self._close_chunk_key(key)
+        self._pending_complete.append(('q', k))
+        return done
+
+    def _close_chunk_key(self, key):
+        """Drop ``key``'s chunk book and move it into the bounded closed
+        ring (stragglers/resends of a finished assembly screen as dups)."""
+        k = tuple(key) if isinstance(key, list) else key
+        self._chunks.pop(k, None)
+        if k not in self._closed_chunk_set:
+            if len(self._closed_chunk_keys) == self._closed_chunk_keys.maxlen:
+                self._closed_chunk_set.discard(self._closed_chunk_keys[0])
+            self._closed_chunk_keys.append(k)
+            self._closed_chunk_set.add(k)
+        return k
+
+    def seed_closed_chunks(self, keys):
+        """Mark assemblies that spool recovery already completed as closed
+        (no journal op: the recovery feed re-derives them every restart),
+        so a reattached gather's resend replays screen as duplicates
+        instead of re-assembling an already-counted episode."""
+        for key in keys:
+            self._close_chunk_key(key)
+
+    def abandon_chunks(self, key):
+        """Drop an abandoned assembly's dedupe state (assembler reap)."""
+        k = tuple(key) if isinstance(key, list) else key
+        if self._chunks.pop(k, None) is not None:
+            self._pending_complete.append(('q', k))
 
     # -- loss handling --
 
@@ -260,19 +362,32 @@ class TaskLedger:
         if self.journal is None or not self._pending_complete:
             self._pending_complete = []
             return
-        for tid in self._pending_complete:
-            self.journal.record('c', tid)
+        for op, val in self._pending_complete:
+            if op == 'q':
+                # streamed assembly closed/abandoned: drop its chunk book
+                self.journal.record('q', -1, key=list(val))
+            elif op == 'p':
+                tid, key, ci = val
+                self.journal.record('p', tid, key=key, ci=ci)
+            else:
+                self.journal.record('c', val)
         self._pending_complete = []
 
     def snapshot_state(self) -> Dict[str, Any]:
         """The durable book: outstanding tasks, the re-issue queue, and
         the tid high-water mark (epoch-synchronous; deltas journal the
         between-epoch churn)."""
-        return {
+        state = {
             'tasks': {tid: entry[1] for tid, entry in self._tasks.items()},
             'reissue': [copy.deepcopy(b) for b in self._reissue],
             'next_tid': self._next_tid,
         }
+        if self._chunks:
+            # streamed-ingest dedupe book: [key, [chunk indices]] pairs
+            # (list form — msgpack maps cannot key on tuples)
+            state['chunks'] = [[list(k), sorted(cis)]
+                               for k, cis in self._chunks.items()]
+        return state
 
     def restore_state(self, state: Dict[str, Any]):
         """Repopulate the book from a :meth:`LedgerJournal.load` replay.
@@ -290,6 +405,13 @@ class TaskLedger:
         self._reissue.extend(state.get('reissue') or ())
         self._next_tid = max(self._next_tid,
                              int(state.get('next_tid') or 0))
+        for pair in state.get('chunks') or ():
+            try:
+                key, cis = pair
+            except Exception:
+                continue
+            k = (str(key[0]), int(key[1]))
+            self._chunks.setdefault(k, set()).update(int(c) for c in cis)
 
     # -- observability --
 
@@ -324,7 +446,9 @@ class LedgerJournal:
       every epoch sync (``snapshot``);
     * ``ledger.delta.wal`` — CRC-framed msgpack records journaled between
       snapshots: ``a`` (assign: tid + base payload), ``c`` (complete),
-      ``s`` (strand → re-issue), ``x`` (cancel, no re-issue). One
+      ``s`` (strand → re-issue), ``x`` (cancel, no re-issue), ``p``
+      (streamed chunk delivered: assembly key + chunk index) and ``q``
+      (streamed assembly closed, its chunk book dropped). One
       O_APPEND write per record, no per-record fsync (same SIGKILL-vs-
       machine-crash stance as the episode spool); a torn tail truncates
       on load.
@@ -358,8 +482,11 @@ class LedgerJournal:
         return (os.path.exists(self.snap_path)
                 or os.path.exists(self.delta_path))
 
-    def record(self, op: str, tid: int, base: Optional[dict] = None):
-        """Append one delta op in a single torn-safe write."""
+    def record(self, op: str, tid: int, base: Optional[dict] = None,
+               **extra):
+        """Append one delta op in a single torn-safe write. ``extra``
+        carries op-specific fields (the streamed-chunk ``p``/``q`` ops'
+        assembly ``key`` and chunk index ``ci``)."""
         if self._delta_fd is None:
             os.makedirs(os.path.dirname(self.delta_path) or '.',
                         exist_ok=True)
@@ -367,6 +494,8 @@ class LedgerJournal:
         rec: Dict[str, Any] = {'op': op, 'tid': int(tid)}
         if base is not None:
             rec['base'] = base
+        if extra:
+            rec.update(extra)
         self._append_record(self._delta_fd, self._pack(rec))
 
     def snapshot(self, state: Dict[str, Any]):
@@ -396,6 +525,17 @@ class LedgerJournal:
         tasks = dict((state or {}).get('tasks') or {})
         reissue = list((state or {}).get('reissue') or ())
         next_tid = int((state or {}).get('next_tid') or 0)
+        # chunk book: keys round-trip through msgpack as lists; normalize
+        # back to hashable tuples for delta folding
+        chunks: Dict[Any, set] = {}
+        closed_chunks: list = []
+        for pair in (state or {}).get('chunks') or ():
+            try:
+                key, cis = pair
+                chunks[(str(key[0]), int(key[1]))] = \
+                    set(int(c) for c in cis)
+            except Exception:
+                continue
         records, valid_bytes, torn = self._read_records(self.delta_path)
         if torn:
             os.truncate(self.delta_path, valid_bytes)
@@ -414,10 +554,35 @@ class LedgerJournal:
                 base = tasks.pop(tid, None)
                 if base is not None:
                     reissue.append(base)
+            elif op == 'p':
+                try:
+                    key = rec['key']
+                    k = (str(key[0]), int(key[1]))
+                    chunks.setdefault(k, set()).add(int(rec['ci']))
+                except Exception:
+                    continue
+            elif op == 'q':
+                try:
+                    key = rec['key']
+                    k = (str(key[0]), int(key[1]))
+                    chunks.pop(k, None)
+                    if k not in closed_chunks:
+                        closed_chunks.append(k)
+                except Exception:
+                    continue
         if state is None and not records:
             return None
-        return {'tasks': tasks, 'reissue': reissue, 'next_tid': next_tid,
-                'extra': dict((state or {}).get('extra') or {})}
+        out = {'tasks': tasks, 'reissue': reissue, 'next_tid': next_tid,
+               'extra': dict((state or {}).get('extra') or {})}
+        if chunks:
+            out['chunks'] = [[list(k), sorted(cis)]
+                             for k, cis in chunks.items()]
+        if closed_chunks:
+            # assemblies closed AFTER the snapshot (delta-only 'q' ops):
+            # their completions post-date the snapshot's counters, so spool
+            # recovery must replay their chunks and re-derive the episode
+            out['chunks_closed'] = [list(k) for k in closed_chunks]
+        return out
 
     def close(self):
         if self._delta_fd is not None:

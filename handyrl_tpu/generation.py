@@ -174,17 +174,25 @@ def _blank_moment(players) -> Dict[str, Dict[int, Any]]:
     return {key: {p: None for p in players} for key in MOMENT_KEYS}
 
 
-def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
-                      gen_args: Dict[str, Any]) -> Optional[dict]:
+def finalize_episode_record(outcome, moments: List[dict],
+                            args: Dict[str, Any], gen_args: Dict[str, Any]
+                            ) -> Optional[dict]:
+    """Build the canonical episode record from raw moments + outcome.
+
+    ONE definition of the record's return fill and compression, shared by
+    every producer — the host generators here, the device actor's splice,
+    and the learner-side ChunkAssembler (streaming.py) reassembling chunked
+    uploads. Returns need only the per-moment rewards, so a reassembled
+    episode's decoded moment stream is bit-identical to a whole-episode
+    upload's by construction (streaming.py spells out the exact claim)."""
     if len(moments) < 1:
         return None
-    for player in env.players():
+    players = list(moments[0]['return'].keys())
+    for player in players:
         ret = 0.0
         for i, m in reversed(list(enumerate(moments))):
             ret = (m['reward'][player] or 0) + args['gamma'] * ret
             moments[i]['return'][player] = ret
-    _EPISODES.inc()
-    _STEPS.inc(len(moments))
     # with engine-mode workers, bz2 compression is the dominant remaining
     # worker-side cost: time it under the shared stage_seconds vocabulary
     t0 = time.perf_counter()
@@ -193,8 +201,38 @@ def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
     telemetry.REGISTRY.observe_stage('compress', time.perf_counter() - t0)
     return {
         'args': gen_args, 'steps': len(moments),
-        'outcome': env.outcome(),
+        'outcome': outcome,
         'moment': blocks,
+    }
+
+
+def _finalize_episode(env, moments: List[dict], args: Dict[str, Any],
+                      gen_args: Dict[str, Any]) -> Optional[dict]:
+    record = finalize_episode_record(env.outcome(), moments, args, gen_args)
+    if record is not None:
+        _EPISODES.inc()
+        _STEPS.inc(len(moments))
+    return record
+
+
+def build_chunk(gen_args: Dict[str, Any], chunk_index: int, base: int,
+                window: List[dict], args: Dict[str, Any],
+                final: bool = False, outcome=None) -> dict:
+    """One streaming upload unit: a fixed-T window of in-flight moments.
+
+    ``window`` moments carry ``'return': None`` (returns are filled by the
+    learner once the final chunk lands); blocks use the SAME compress_steps
+    grid as whole episodes (streaming.chunk_steps is validated to be a
+    multiple of compress_steps), so a partial episode's chunk blocks index
+    exactly like a finished record's and the batch builder can window into
+    them unchanged."""
+    return {
+        'args': dict(gen_args), 'chunk': int(chunk_index), 'base': int(base),
+        'steps': len(window),
+        'moment': compress_moments(window, args['compress_steps'],
+                                   level=args.get('compress_level', 9)),
+        'final': bool(final),
+        'outcome': outcome if final else None,
     }
 
 
@@ -224,14 +262,26 @@ class Generator:
         moment['action_mask'][player] = res['action_mask']
         moment['action'][player] = res['action']
 
-    def generate(self, models: Dict[int, Any], gen_args: Dict[str, Any]
-                 ) -> Optional[dict]:
+    def generate(self, models: Dict[int, Any], gen_args: Dict[str, Any],
+                 emit=None) -> Optional[dict]:
         base_seed = self.args.get('seed', 0)
         skey = (gen_args or {}).get('sample_key')
         episode_key = ((0, int(skey)) if skey is not None
                        else (1, self.namespace, self._local_episodes))
         self._local_episodes += 1
         draws = 0
+        # streaming ingest: flush fixed-T chunks of the in-flight episode
+        # through ``emit`` instead of holding it to completion. Boundaries
+        # are a pure function of (seed, sample_key, T): every ply index is
+        # deterministic under the purity contract, so a re-issued attempt
+        # regenerates byte-identical chunks and the learner's duplicate
+        # screen merges them. Only server-keyed tasks stream (the dedupe
+        # key IS the sample_key).
+        stream = None
+        if emit is not None and skey is not None:
+            stream = {'T': int((self.args.get('streaming') or {})
+                               .get('chunk_steps', 32)),
+                      'flushed': 0, 'chunk': 0}
         # envs with stochastic transitions keep a per-instance rng (e.g.
         # HungryGeese spawns); reseeding it from the episode key makes the
         # whole episode a pure function of (seed, sample_key, params) —
@@ -294,9 +344,31 @@ class Generator:
             moment['turn'] = turn_players
             moments.append(moment)
 
+            if stream is not None and \
+                    len(moments) - stream['flushed'] >= stream['T']:
+                window = moments[stream['flushed']:
+                                 stream['flushed'] + stream['T']]
+                emit(build_chunk(gen_args, stream['chunk'],
+                                 stream['flushed'], window, self.args))
+                stream['flushed'] += stream['T']
+                stream['chunk'] += 1
+
+        if stream is not None:
+            if len(moments) < 1:
+                return None
+            # final chunk: the moments past the last full window (possibly
+            # zero of them) plus the outcome that closes the episode
+            emit(build_chunk(gen_args, stream['chunk'], stream['flushed'],
+                             moments[stream['flushed']:], self.args,
+                             final=True, outcome=self.env.outcome()))
+            _EPISODES.inc()
+            _STEPS.inc(len(moments))
+            return {'streamed': True, 'args': gen_args,
+                    'steps': len(moments)}
+
         return _finalize_episode(self.env, moments, self.args, gen_args)
 
-    def execute(self, models, gen_args) -> Optional[dict]:
+    def execute(self, models, gen_args, emit=None) -> Optional[dict]:
         # episode-lifecycle tracing: the whole env-stepping span, keyed by
         # the trace_id derived from the server-stamped task — the worker-
         # side hop of the task_assign -> generate -> upload -> ingest ->
@@ -304,7 +376,7 @@ class Generator:
         with telemetry.trace_span(
                 'generate', trace_id=telemetry.episode_trace_id(gen_args),
                 worker=self.namespace):
-            episode = self.generate(models, gen_args)
+            episode = self.generate(models, gen_args, emit=emit)
         if episode is None:
             telemetry.get_logger('generation').warning(
                 'None episode in generation!')

@@ -68,28 +68,66 @@ def decompress_moments(blocks: Sequence[bytes]) -> List[dict]:
     return out
 
 
-def select_episode(episodes: Sequence[dict], args: Dict[str, Any]) -> dict:
+def _chunk_recv_time(ep: dict, train_st: int):
+    """Ingest timestamp of the window at ``train_st``: per-chunk for a
+    streamed entry (streaming.py stamps ``chunk_recv`` per exposed window),
+    the whole-episode stamp otherwise."""
+    recv = ep.get('chunk_recv')
+    if recv:
+        T = max(1, int(ep.get('chunk_steps') or 1))
+        return recv[min(train_st // T, len(recv) - 1)]
+    return ep.get('recv_time')
+
+
+def select_episode(episodes: Sequence[dict], args: Dict[str, Any],
+                   now=None) -> dict:
     """Recency-biased episode + window sampling (train.py:291-315).
 
     Index i among N buffered episodes is accepted with probability
     (i+1)/N — newer episodes are proportionally more likely — then a uniform
     random ``forward_steps`` window (plus up to ``burn_in_steps`` of warmup
     context) is sliced out, keeping only the compressed blocks it covers.
-    """
-    while True:
-        ep_count = min(len(episodes), args['maximum_episodes'])
-        ep_idx = random.randrange(ep_count)
-        accept_rate = 1 - (ep_count - 1 - ep_idx) / ep_count
-        if random.random() >= accept_rate:
-            continue
-        try:
-            ep = episodes[ep_idx]
-            break
-        except IndexError:
-            continue
 
-    turn_candidates = 1 + max(0, ep['steps'] - args['forward_steps'])
-    train_st = random.randrange(turn_candidates)
+    With ``streaming.staleness_half_life`` > 0 and streamed (chunk-stamped)
+    entries in the buffer, a drawn window is additionally accepted with
+    probability ``0.5 ** (chunk_age / half_life)`` over its PER-CHUNK
+    ``sample_age`` — stale windows of long in-flight episodes decay instead
+    of sampling uniformly — re-drawing episode + window up to
+    ``streaming.max_reselect`` times before accepting regardless (bounded
+    work, no starvation). The knob at 0 adds ZERO random draws: the off
+    path is byte-identical to the pre-streaming sampler.
+    """
+    stm = args.get('streaming') or {}
+    half_life = float(stm.get('staleness_half_life', 0.0) or 0.0)
+    reselects = int(stm.get('max_reselect', 4)) if half_life > 0 else 0
+    while True:
+        while True:
+            ep_count = min(len(episodes), args['maximum_episodes'])
+            ep_idx = random.randrange(ep_count)
+            accept_rate = 1 - (ep_count - 1 - ep_idx) / ep_count
+            if random.random() >= accept_rate:
+                continue
+            try:
+                ep = episodes[ep_idx]
+                break
+            except IndexError:
+                continue
+
+        turn_candidates = 1 + max(0, ep['steps'] - args['forward_steps'])
+        train_st = random.randrange(turn_candidates)
+        if reselects <= 0:
+            break
+        recv = _chunk_recv_time(ep, train_st)
+        if recv is None:
+            break
+        if now is None:
+            import time as _time
+            now = _time.time()
+        age = max(0.0, float(now) - float(recv))
+        if random.random() < 0.5 ** (age / half_life):  # graftlint: allow[GL001] learner-side window SELECTION, not record production — same process-global stream the surrounding sampler (train.py:291-315 parity) already draws from, and active only when streaming.staleness_half_life opts in
+            break
+        reselects -= 1
+
     st = max(0, train_st - args['burn_in_steps'])
     ed = min(train_st + args['forward_steps'], ep['steps'])
     cs = args['compress_steps']
@@ -99,10 +137,11 @@ def select_episode(episodes: Sequence[dict], args: Dict[str, Any]) -> dict:
         'moment': ep['moment'][st_block:ed_block],
         'base': st_block * cs,
         'start': st, 'end': ed, 'train_start': train_st, 'total': ep['steps'],
-        # learner ingest timestamp (stamped by feed_episodes): selection is
-        # the consumption point, so the batcher can histogram sample age
-        # (policy-lag accounting, docs/observability.md)
-        'recv_time': ep.get('recv_time'),
+        # learner ingest timestamp (stamped by feed_episodes, or per-chunk
+        # by the streaming assembler): selection is the consumption point,
+        # so the batcher can histogram sample age over the data actually
+        # trained on (policy-lag accounting, docs/observability.md)
+        'recv_time': _chunk_recv_time(ep, train_st),
     }
 
 

@@ -44,6 +44,10 @@ class LossConfig(NamedTuple):
     gamma: float = 0.8
     entropy_regularization: float = 0.1
     entropy_regularization_decay: float = 0.1
+    # IMPACT-style clipped target network (streaming.target_clip): > 0
+    # replaces the V-Trace behavior ratio with the target-network ratio
+    # pi_target/mu, clipped at this value. 0 = off (byte-identical step).
+    target_clip: float = 0.0
 
     @classmethod
     def from_args(cls, args: Dict[str, Any]) -> 'LossConfig':
@@ -57,6 +61,8 @@ class LossConfig(NamedTuple):
             gamma=float(args['gamma']),
             entropy_regularization=float(args['entropy_regularization']),
             entropy_regularization_decay=float(args['entropy_regularization_decay']),
+            target_clip=float((args.get('streaming') or {})
+                              .get('target_clip', 0.0) or 0.0),
         )
 
 
@@ -220,7 +226,7 @@ def optax_huber(pred: jnp.ndarray, target: jnp.ndarray, delta: float = 1.0
 
 
 def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
-                 cfg: LossConfig, batch_stats=None
+                 cfg: LossConfig, batch_stats=None, target_params=None
                  ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Full pipeline: forward, targets, advantages, composed losses.
 
@@ -229,6 +235,16 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
     may pass the full variables dict as ``params`` (the batch_stats
     collection is split off here) or pass ``batch_stats`` explicitly; the
     advanced running averages come back as ``aux['batch_stats']``.
+
+    ``target_params`` (with ``cfg.target_clip`` > 0) engages the
+    IMPACT-style clipped target network: a second, stop-gradient forward
+    under the slow-moving target params supplies the importance ratio
+    pi_target/mu used for the V-Trace corrections — clipped at
+    ``target_clip`` for rho, at 1 for c — in place of the current-policy
+    ratio. Streamed (staler) data then drives value targets through a
+    policy that moves once per ``target_sync_epochs`` instead of every
+    SGD step, which is what keeps high-lag chunks trainable. The policy
+    gradient itself still differentiates the CURRENT policy's log-prob.
     """
     if batch_stats is None:
         params, batch_stats = split_batch_stats(params)
@@ -238,10 +254,23 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
     if batch_stats is not None:
         outputs, new_bs = outputs
 
+    use_target = target_params is not None and cfg.target_clip > 0
+    tgt_outputs = None
+    if use_target:
+        t_params, t_bs = split_batch_stats(target_params)
+        tgt_outputs = forward_prediction(apply_fn, t_params, init_hidden,
+                                         batch, cfg, t_bs)
+        if t_bs is not None:
+            tgt_outputs, _ = tgt_outputs   # target stats never advance
+        tgt_outputs = {k: lax.stop_gradient(v)
+                       for k, v in tgt_outputs.items()}
+
     bi = cfg.burn_in_steps
     if bi > 0:
         batch = _slice_burn_in(batch, bi)
         outputs = {k: v[:, bi:] for k, v in outputs.items()}
+        if tgt_outputs is not None:
+            tgt_outputs = {k: v[:, bi:] for k, v in tgt_outputs.items()}
 
     actions = batch['action']
     emasks = batch['episode_mask']
@@ -256,8 +285,15 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
 
     log_rhos = lax.stop_gradient(log_t) - log_b
     rhos = jnp.exp(log_rhos)
-    clipped_rhos = jnp.clip(rhos, 0, clip_rho)
-    cs = jnp.clip(rhos, 0, clip_c)
+    if use_target:
+        logp_tgt = jax.nn.log_softmax(tgt_outputs['policy'], axis=-1)
+        log_tgt = jnp.take_along_axis(logp_tgt, actions, axis=-1) * emasks
+        rhos_tgt = jnp.exp(log_tgt - log_b)
+        clipped_rhos = jnp.clip(rhos_tgt, 0, cfg.target_clip)
+        cs = jnp.clip(rhos_tgt, 0, clip_c)
+    else:
+        clipped_rhos = jnp.clip(rhos, 0, clip_rho)
+        cs = jnp.clip(rhos, 0, clip_c)
     outputs_nograd = {k: lax.stop_gradient(v) for k, v in outputs.items()}
 
     if 'value' in outputs_nograd:
@@ -305,6 +341,15 @@ def compute_loss(apply_fn, params, init_hidden, batch: Dict[str, Any],
         'rho_sum': (rhos * tmask).sum(),
         'rho_sq_sum': (jnp.square(rhos) * tmask).sum(),
     }
+    if use_target:
+        # target-network health: clip fraction and first moment of the
+        # target/behavior ratio, plus the current-vs-target log-prob gap
+        # on taken actions (a drift/KL proxy) — how far the fast policy
+        # has moved since the last target sync
+        diag['target_clip'] = ((rhos_tgt > cfg.target_clip) * tmask).sum()
+        diag['target_ratio_sum'] = (rhos_tgt * tmask).sum()
+        diag['target_gap_sum'] = ((lax.stop_gradient(log_t) - log_tgt)
+                                  * tmask).sum()
     aux = {'losses': losses, 'data_count': dcnt, 'diag': diag}
     if new_bs is not None:
         aux['batch_stats'] = new_bs

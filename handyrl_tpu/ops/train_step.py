@@ -67,14 +67,16 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
         P = batch['value'].shape[2]
         return module.init_hidden((B, P))
 
-    def update(state: TrainState, batch: Dict[str, Any], lr: jnp.ndarray
+    def update(state: TrainState, batch: Dict[str, Any], lr: jnp.ndarray,
+               target_params=None
                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         init_hidden = init_hidden_for(batch)
         trainable, batch_stats = split_batch_stats(state.params)
 
         def loss_fn(params):
             return compute_loss(apply_fn, params, init_hidden, batch, cfg,
-                                batch_stats=batch_stats)
+                                batch_stats=batch_stats,
+                                target_params=target_params)
 
         (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(trainable)
         new_bs = aux.pop('batch_stats', None)
@@ -135,11 +137,19 @@ def _update_core(module, cfg: LossConfig, optimizer, axis_name=None):
 
 
 def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True,
-                      state_shardings=None):
+                      state_shardings=None, use_target: bool = False):
     """Returns update(state, batch, lr) -> (state, metrics), jit-compiled.
 
     ``metrics`` carries the per-term loss sums and the turn count of the
     batch (the reference's ``dcnt``) as device scalars.
+
+    With ``use_target`` the compiled signature gains a 4th argument —
+    update(state, batch, lr, target_params) — the frozen IMPACT target
+    network's trainable params (losses.py target_clip). They are replicated
+    like any other scalar input and NOT donated: the live params buffer is
+    donated every step, so the target must keep its own device copy to
+    survive between refreshes (train.py syncs it every
+    streaming.target_sync_epochs epochs).
 
     On a mesh the program carries explicit NamedSharding types: the batch
     shards along 'data', and the TrainState layout comes from
@@ -166,9 +176,13 @@ def build_update_step(module, cfg: LossConfig, mesh=None, donate: bool = True,
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
     state_sh = state_shardings if state_shardings is not None else repl
+    # the target copy mirrors the live params' layout (it IS a copy of
+    # them), so its sharding is the state tree's params component
+    tgt_sh = getattr(state_sh, 'params', state_sh)
+    in_sh = (state_sh, data, repl) + ((tgt_sh,) if use_target else ())
     return jax.jit(
         update,
-        in_shardings=(state_sh, data, repl),
+        in_shardings=in_sh,
         out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )

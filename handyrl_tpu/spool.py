@@ -17,12 +17,17 @@ Anatomy:
   the LIVE segment can ever hold a torn tail;
 * each record's payload is ``connection.pack({'idx': N, 'episode': ...})``
   — ``idx`` is the learner's monotonic admission index, which makes
-  recovery horizons and GC exact without a separate index file (and the
-  framing is chunk-shaped on purpose: a streaming-ingest journal can reuse
-  it with a chunk payload instead of a whole episode);
+  recovery horizons and GC exact without a separate index file. Streaming
+  ingest (docs/large_scale_training.md "Streaming ingest") reuses the same
+  framing with a ``{'idx': N, 'chunk': ...}`` payload — partial-episode
+  window chunks land here BEFORE the ledger journals their delivery, so
+  SIGKILL recovery and duplicate screening extend to in-flight episodes;
 * recovery (``recover``) scans segments in order, truncates a torn tail in
   place (os.truncate to the last good frame boundary), and yields the
-  episodes with ``idx >= min_idx``;
+  episodes with ``idx >= min_idx`` (chunk records ride the same scan; the
+  learner screens them against the ledger's reassembly book — open
+  assemblies hold the GC horizon back to their first spooled chunk, so a
+  restart can always rebuild every partially-delivered episode);
 * GC (``gc``) deletes closed segments whose newest record fell behind the
   checkpoint consumption horizon, always retaining the newest
   ``keep_segments`` closed segments as cushion — disk stays bounded.

@@ -1,0 +1,259 @@
+"""Streaming partial-episode ingest: learner-side chunk reassembly.
+
+With the ``streaming:`` config block enabled, workers (and the device
+actor backend) flush fixed-T window chunks of in-flight episodes through
+the existing upload path instead of holding completed episodes
+(generation.py ``build_chunk``). This module owns the learner half: the
+:class:`ChunkAssembler` merges arriving chunks back into episodes.
+
+Two invariants carry the whole design:
+
+* **Purity** — a host-path episode is a pure function of
+  (seed, sample_key, params), so chunk boundaries (a pure function of the
+  ply index and T) are too. A re-issued attempt of a stranded task
+  regenerates byte-identical chunks under the SAME sample_key; assemblies
+  are therefore keyed by sample_key and duplicate chunks (re-issue
+  overlap, resend-buffer replays, restart recovery) merge instead of
+  double-counting. Device-actor streams carry ``record_version`` — their
+  episodes are NOT sample_key-pure (the block seed differs per attempt) —
+  so those assemblies key by task_id and never merge across attempts.
+
+* **Byte-identity** — chunk moments ship with ``'return': None`` and the
+  final chunk carries the outcome; reassembly concatenates the decoded
+  windows and hands them to ``generation.finalize_episode_record`` — the
+  same return fill, block grid and compression every whole-episode
+  producer uses — so the reassembled record's training-visible bytes (the
+  decoded moment stream, filled returns, outcome) are bit-identical to a
+  whole-episode upload's. The raw bz2 block bytes are the canonical
+  (pickle fixed-point) encoding, which can differ from the worker's
+  fresh-object encoding only in pickle memo layout, never in content
+  (pinned by tests/test_streaming.py).
+
+While an episode is in flight the assembler exposes a PARTIAL buffer
+entry (``'partial': True``, provisional zero outcome, returns None) made
+of the contiguous chunk prefix: streaming.chunk_steps is validated to be
+a multiple of compress_steps, so the chunk-local bz2 blocks land on the
+whole-episode block grid and ``ops/batch.py`` windows into them
+unchanged. Entries are mutated append-only in an order safe for the
+concurrent batcher readers (blocks first, then the step count).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from . import telemetry
+from .generation import finalize_episode_record
+from .ops.batch import decompress_moments
+
+
+def streaming_enabled(args: Dict[str, Any]) -> bool:
+    return bool((args.get('streaming') or {}).get('enabled'))
+
+
+def chunk_key(chunk: Dict[str, Any]):
+    """Assembly/dedupe key for one chunk.
+
+    Host-contract streams (pure per sample_key) merge across re-issued
+    attempts; ``record_version``-stamped device streams are per-attempt
+    and key by task_id. None for a chunk that carries neither key (never
+    produced by this codebase; screened out defensively)."""
+    args = chunk.get('args') or {}
+    skey = args.get('sample_key')
+    if not chunk.get('record_version') and skey is not None:
+        return ('k', int(skey))
+    tid = args.get('task_id')
+    if tid is not None:
+        return ('t', int(tid))
+    return None
+
+
+class ChunkAssembler:
+    """Merge streamed chunks back into episode records.
+
+    ``add`` is called from the learner's server thread only (and from
+    spool recovery before the fleet attaches); the entries it exposes are
+    read concurrently by the batcher threads. One assembler per learner.
+    """
+
+    def __init__(self, args: Dict[str, Any], check_finite: bool = True,
+                 clock=time.time):
+        self.args = args
+        self._check_finite = bool(check_finite)
+        self._clock = clock
+        self._open: Dict[Any, dict] = {}
+        self._m_open = telemetry.gauge('streaming_open_assemblies')
+        self._m_done = telemetry.counter(
+            'streaming_reassembled_episodes_total')
+
+    # -- ingest -----------------------------------------------------------
+
+    def add(self, chunk: Dict[str, Any], mark: Optional[int] = None) -> dict:
+        """Fold one (already ledger-screened) chunk into its assembly.
+
+        ``mark`` is the spool index the chunk was WAL'd under (the GC
+        horizon must not pass an open assembly's first mark). Returns a
+        dict with ``status``:
+
+        * ``'dropped'`` — unkeyed/duplicate/poisoned chunk, nothing to do;
+        * ``'open'`` — partial data landed; ``entry`` is the live buffer
+          entry and ``new`` says whether the caller must insert it;
+        * ``'complete'`` — the episode reassembled; ``record`` is the
+          canonical record (already swapped into ``entry``), or None when
+          a poisoned chunk froze the assembly (the task still completes);
+          ``final_args`` is the closing chunk attempt's task args.
+        """
+        key = chunk_key(chunk)
+        if key is None:
+            return {'status': 'dropped'}
+        asm = self._open.get(key)
+        if asm is None:
+            asm = self._open[key] = {
+                'chunks': {}, 'final_ci': None, 'outcome': None,
+                'final_args': None, 'next': 0, 'entry': None,
+                'mark': mark, 'poisoned': False, 'touched': self._clock(),
+                'stamped': bool(chunk.get('record_version')),
+            }
+            self._m_open.set(len(self._open))
+        asm['touched'] = self._clock()
+        if mark is not None and (asm['mark'] is None or mark < asm['mark']):
+            asm['mark'] = mark
+        ci = int(chunk.get('chunk', 0))
+        if ci in asm['chunks']:
+            return {'status': 'dropped'}     # duplicate window (merged)
+        if self._check_finite:
+            from . import guard as guard_mod
+            if not guard_mod.episode_is_finite(
+                    {'outcome': chunk.get('outcome'),
+                     'moment': chunk.get('moment') or []}):
+                # freeze: the clean contiguous prefix stays usable, but no
+                # further data is exposed and the record is dropped whole
+                asm['poisoned'] = True
+        try:
+            moments = ([] if asm['poisoned']
+                       else decompress_moments(chunk.get('moment') or []))
+        except Exception:
+            asm['poisoned'] = True
+            moments = []
+        asm['chunks'][ci] = {'moments': moments,
+                             'blocks': list(chunk.get('moment') or [])}
+        if chunk.get('final'):
+            asm['final_ci'] = ci
+            asm['outcome'] = chunk.get('outcome')
+            asm['final_args'] = dict(chunk.get('args') or {})
+        new = self._expose(asm, chunk)
+        fin = asm['final_ci']
+        if fin is not None:
+            if asm['poisoned']:
+                # a poisoned stream still closes its TASK once every
+                # window landed (mirroring the whole-episode path, where
+                # admit completes the task before the guard drops the
+                # record) — otherwise the deadline loop would re-issue
+                # the same deterministic poison forever
+                if all(c in asm['chunks'] for c in range(fin + 1)):
+                    return self._complete(key, asm, new)
+            elif asm['next'] > fin:
+                return self._complete(key, asm, new)
+        return {'status': 'open', 'entry': asm['entry'], 'new': new}
+
+    def _expose(self, asm: dict, chunk: Dict[str, Any]) -> bool:
+        """Extend the live buffer entry with the contiguous chunk prefix.
+
+        Mutation order is the thread-safety contract with the batcher
+        readers: blocks are appended BEFORE the step count moves, so a
+        concurrent window selection never indexes past decoded data."""
+        new = False
+        now = time.time()
+        while not asm['poisoned'] and asm['next'] in asm['chunks']:
+            ci = asm['next']
+            moments = asm['chunks'][ci]['moments']
+            blocks = asm['chunks'][ci]['blocks']
+            entry = asm['entry']
+            if entry is None and moments:
+                players = list(moments[0]['return'].keys())
+                entry = asm['entry'] = {
+                    'args': dict(chunk.get('args') or {}),
+                    'outcome': {p: 0.0 for p in players},   # provisional
+                    'moment': [], 'steps': 0, 'partial': True,
+                    'recv_time': now, 'chunk_recv': [],
+                    'chunk_steps': int((self.args.get('streaming') or {})
+                                       .get('chunk_steps', 32)),
+                }
+                if asm['stamped']:
+                    entry['record_version'] = 1
+                new = True
+            if entry is not None:
+                entry['moment'].extend(blocks)
+                entry['chunk_recv'].append(now)
+                entry['steps'] += len(moments)
+            asm['next'] = ci + 1
+        return new
+
+    def _complete(self, key, asm: dict, new: bool) -> dict:
+        """All windows landed: build the canonical record and swap it into
+        the live entry (readers mid-swap see a consistent prefix)."""
+        self._open.pop(key, None)
+        self._m_open.set(len(self._open))
+        record = None
+        entry = asm['entry']
+        if not asm['poisoned']:
+            moments: List[dict] = []
+            for ci in range(asm['final_ci'] + 1):
+                moments.extend(asm['chunks'][ci]['moments'])
+            record = finalize_episode_record(
+                asm['outcome'], moments, self.args, asm['final_args'])
+        if record is not None:
+            if asm['stamped']:
+                record['record_version'] = 1
+            self._m_done.inc()
+            if entry is None:
+                # single-shot completion (episode shorter than T, or a
+                # recovery replay): expose the finished record directly
+                entry = asm['entry'] = dict(record)
+                entry['chunk_recv'] = [time.time()]
+                entry['chunk_steps'] = int(
+                    (self.args.get('streaming') or {})
+                    .get('chunk_steps', 32))
+                new = True
+            else:
+                entry['args'] = record['args']
+                entry['moment'] = record['moment']
+                entry['outcome'] = record['outcome']
+                entry['steps'] = record['steps']
+                entry.pop('partial', None)
+        return {'status': 'complete', 'record': record, 'entry': entry,
+                'final_args': asm['final_args'], 'new': new}
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def min_open_mark(self) -> Optional[int]:
+        """Lowest spool index any open assembly's chunks were WAL'd under:
+        the epoch GC horizon is held back to it so a restart can still
+        replay every chunk of a partially-delivered episode."""
+        marks = [asm['mark'] for asm in self._open.values()
+                 if asm['mark'] is not None]
+        return min(marks) if marks else None
+
+    def open_count(self) -> int:
+        return len(self._open)
+
+    def reap(self, older_than: float) -> list:
+        """Abandon assemblies untouched for ``older_than`` seconds;
+        returns the reaped keys (the caller drops their ledger book).
+
+        A host-contract assembly is normally finished by the re-issued
+        attempt (same sample_key), but a device-actor stream whose attempt
+        died can never complete (the re-issue keys a new task_id) — and
+        either way an assembly must not pin the spool GC horizon forever.
+        The exposed partial entry (clean, screened data) stays in the
+        buffer with its provisional outcome."""
+        now = self._clock()
+        stale = [key for key, asm in self._open.items()
+                 if now - asm['touched'] > older_than]
+        for key in stale:
+            self._open.pop(key, None)
+            telemetry.counter('streaming_abandoned_assemblies_total').inc()
+        if stale:
+            self._m_open.set(len(self._open))
+        return stale

@@ -398,12 +398,29 @@ class Trainer:
         if self.mesh is not None and self.state is not None:
             self.state_sharding = tree_shardings(self.mesh, self.state,
                                                  self.partition_rules)
+        # IMPACT clipped target network (streaming.target_clip > 0): the
+        # update step takes a frozen params copy whose ratios drive the
+        # V-Trace targets (ops/losses.py). Deliberately NOT checkpointed:
+        # at restart it re-initializes from the loaded params — one epoch
+        # of target lag lost, no checkpoint format change. The fused replay
+        # trainer has no target variant, so replay mode ignores the knob.
+        stm = args.get('streaming') or {}
+        self._use_target = float(stm.get('target_clip') or 0.0) > 0
+        if self._use_target and args.get('device_replay'):
+            _LOG.warning('streaming.target_clip is ignored in device_replay '
+                         'mode (the fused trainer has no target variant)')
+            self._use_target = False
+        self.target_params = None
+        self.target_sync_epochs = max(
+            1, int(stm.get('target_sync_epochs') or 1))
+        self._target_age_epochs = 0
         # the step donates its input state (params/opt buffers reused in
         # place); the actor-facing wrapper keeps its own copy of the params,
         # refreshed only at epoch boundaries
         self.update_step = build_update_step(
             wrapper.module, self.cfg, self.mesh, donate=True,
-            state_shardings=self.state_sharding)
+            state_shardings=self.state_sharding,
+            use_target=self._use_target)
 
         self.default_lr = 3e-8
         self.data_cnt_ema = args['batch_size'] * args['forward_steps']
@@ -588,6 +605,9 @@ class Trainer:
         self.state = self.place_state(state)
         self.steps = int(payload['steps'])
         self.data_cnt_ema = float(payload['data_cnt_ema'])
+        # the IMPACT target network is not part of the checkpoint: drop any
+        # stale copy so the next epoch re-syncs it from the loaded params
+        self.target_params = None
 
     def update(self, timeout: Optional[float] = None):
         """Called by the learner at each epoch boundary; blocks until the
@@ -608,6 +628,16 @@ class Trainer:
         batch_cnt, data_cnt = 0, 0
         pending_metrics: List[Dict[str, jnp.ndarray]] = []
         epoch_t0 = time.time()
+
+        # target-network sync at the epoch boundary: a genuine device copy
+        # (jnp.copy) because the live params buffer is donated every step.
+        # Also (re)materializes after a restart/rollback replaced the state.
+        if self._use_target and (
+                self.target_params is None
+                or self._target_age_epochs >= self.target_sync_epochs):
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.state.params)
+            self._target_age_epochs = 0
 
         if self._profile_dir and not self._profiled and self.steps > 0:
             self._start_trace()
@@ -739,7 +769,11 @@ class Trainer:
             lr = jnp.asarray(lr_val, jnp.float32)
             t_wall = time.time()
             t_dispatch = time.perf_counter()
-            self.state, metrics = self.update_step(self.state, batch, lr)
+            if self._use_target:
+                self.state, metrics = self.update_step(
+                    self.state, batch, lr, self.target_params)
+            else:
+                self.state, metrics = self.update_step(self.state, batch, lr)
             dt_dispatch = time.perf_counter() - t_dispatch
             timer.add('dispatch', dt_dispatch)
             if batch_tids:
@@ -780,6 +814,8 @@ class Trainer:
             self.data_cnt_ema = (self.data_cnt_ema * 0.8
                                  + data_cnt / (1e-2 + batch_cnt) * 0.2)
             self.last_steps_per_sec = batch_cnt / max(time.time() - epoch_t0, 1e-9)
+            if self._use_target:
+                self._target_age_epochs += 1
             self.last_dynamics = self._epoch_dynamics(loss_sum, data_cnt,
                                                       batch_cnt)
             # the epoch's per-stage seconds feed the device-utilization
@@ -932,6 +968,15 @@ class Trainer:
             out['importance_ratio_mean'] = mean
             var = max(0.0, d.get('diag_rho_sq_sum', 0.0) / dc - mean * mean)
             out['importance_ratio_std'] = var ** 0.5
+        if 'diag_target_clip' in d:
+            # IMPACT target-network dynamics (losses.py target_clip):
+            # clip fraction + mean of the target/behavior ratio, and the
+            # mean current-vs-target log-prob gap (how far the live policy
+            # has drifted from the frozen target since the last sync)
+            out['target_clip_fraction'] = d['diag_target_clip'] / dc
+            out['target_ratio_mean'] = (
+                d.get('diag_target_ratio_sum', 0.0) / dc)
+            out['target_gap_mean'] = d.get('diag_target_gap_sum', 0.0) / dc
         if 'diag_grad_norm' in d:
             out['grad_norm'] = d['diag_grad_norm'] / nu
         out = {k: round(float(v), 6) for k, v in out.items()}
@@ -1303,6 +1348,14 @@ class Learner:
         if remote and bool(dur.get('ledger_snapshot', True)):
             self._ledger_journal = LedgerJournal(
                 args.get('model_dir', 'models'))
+        # streaming ingest (streaming.py): one assembler merges chunked
+        # uploads back into episodes. Constructed unconditionally (cheap,
+        # inert while no chunk arrives) so spool recovery can replay chunk
+        # records even if the restarted config flipped streaming off.
+        from .streaming import ChunkAssembler
+        self._assembler = ChunkAssembler(
+            args, check_finite=self._check_episodes)
+        self._recovered_closed_chunks: list = []
         self._load_durable_state()
 
         # the scrape endpoint binds only once everything it reads (trainer,
@@ -1391,14 +1444,77 @@ class Learner:
                 # book before the ledger ever sees it (this closes the
                 # only crash window — admitted but completion unflushed)
                 tasks = (state or {}).get('tasks')
+                # records below the restored returned-counter were already
+                # counted by the dead incarnation; they only live in the
+                # spool because the GC horizon holds back to the oldest
+                # open streamed assembly — replaying them would double-count
+                counted = self.num_returned_episodes
+                episodes = []
                 for rec in recovered:
-                    tid = ((rec.get('episode') or {}).get('args')
-                           or {}).get('task_id')
+                    episode = rec.get('episode')
+                    if episode is None \
+                            or int(rec.get('idx') or 0) < counted:
+                        continue
+                    episodes.append(episode)
+                    tid = (episode.get('args') or {}).get('task_id')
                     if tasks is not None and tid is not None:
                         tasks.pop(tid, None)
-                self.feed_episodes(
-                    [rec.get('episode') for rec in recovered],
-                    recovered=True)
+                self.feed_episodes(episodes, recovered=True)
+                # streamed chunk records replay through the assembler under
+                # their original spool indices; an episode whose every
+                # window was WAL'd reassembles right here — cancel its
+                # restored task (tid, plus the sample_key scan for a pure
+                # stream whose final attempt differed) and remember the key
+                # so the ledger screens post-restart resends of it. A
+                # still-open assembly keeps its restored book entry: the
+                # re-issue regenerates the missing windows (the delivered
+                # ones screen as duplicates in the restored chunk book).
+                # The replay screen: a chunk replays iff its assembly is
+                # still open in the restored book, closed by a POST-snapshot
+                # delta (completion not yet in the restored counters), or
+                # spooled past the counter — assemblies completed before the
+                # snapshot are already counted and must stay dropped.
+                from .streaming import chunk_key
+                live_keys = set()
+                for pair in (state or {}).get('chunks') or ():
+                    try:
+                        live_keys.add((str(pair[0][0]), int(pair[0][1])))
+                    except Exception:
+                        continue
+                for k in (state or {}).get('chunks_closed') or ():
+                    try:
+                        live_keys.add((str(k[0]), int(k[1])))
+                    except Exception:
+                        continue
+                chunk_recs = [
+                    rec for rec in recovered
+                    if rec.get('chunk') is not None
+                    and (int(rec.get('idx') or 0) >= counted
+                         or chunk_key(rec['chunk']) in live_keys)]
+                if chunk_recs:
+                    done = self.feed_chunks(
+                        [rec['chunk'] for rec in chunk_recs],
+                        recovered=True,
+                        marks=[int(rec.get('idx') or 0)
+                               for rec in chunk_recs])
+                    for key, final_args in done:
+                        self._recovered_closed_chunks.append(key)
+                        if tasks is None:
+                            continue
+                        tid = (final_args or {}).get('task_id')
+                        if tid is not None:
+                            tasks.pop(tid, None)
+                        if key and key[0] == 'k':
+                            for t, base in list(tasks.items()):
+                                if isinstance(base, dict) \
+                                        and base.get('sample_key') == key[1] \
+                                        and base.get('role') == 'g':
+                                    tasks.pop(t, None)
+                    print('durable plane: replayed %d spooled chunk(s) '
+                          '(%d episode(s) reassembled, %d assembly(ies) '
+                          'still open)'
+                          % (len(chunk_recs), len(done),
+                             self._assembler.open_count()))
                 self._durable_restored = True
                 print('durable plane: recovered %d spooled episode(s) '
                       'past horizon %d (zero admitted episodes lost)'
@@ -1426,6 +1542,14 @@ class Learner:
         """Epoch-sync the durable plane (rides every checkpoint write):
         republish the ledger snapshot — folding the delta journal — and
         GC spool segments behind the new consumption horizon."""
+        # the consumption horizon holds back to the oldest OPEN streamed
+        # assembly's first WAL mark: a restart must be able to replay every
+        # window of a partially-delivered episode, even ones spooled before
+        # episodes that already completed
+        horizon = self.num_returned_episodes
+        open_mark = self._assembler.min_open_mark()
+        if open_mark is not None:
+            horizon = min(horizon, int(open_mark))
         if self.ledger is not None and self._ledger_journal is not None:
             self.ledger.flush_journal()
             state = self.ledger.snapshot_state()
@@ -1433,11 +1557,11 @@ class Learner:
                 'num_episodes': self.num_episodes,
                 'num_results': self.num_results,
                 'num_returned_episodes': self.num_returned_episodes,
-                'spool_horizon': self.num_returned_episodes,
+                'spool_horizon': horizon,
             }
             self._ledger_journal.snapshot(state)
         if self._spool is not None:
-            self._spool_horizon = self.num_returned_episodes
+            self._spool_horizon = horizon
             self._spool.gc(self._spool_horizon)
 
     # -- checkpoints ------------------------------------------------------
@@ -1883,6 +2007,11 @@ class Learner:
                 except queue.Full:
                     self.trainer.replay_stats['dropped_episodes'] += 1
 
+        self._evict_episode_overflow()
+
+    def _evict_episode_overflow(self):
+        """Bound the host episode deque (memory-pressure-aware), shared by
+        the whole-episode and streamed-chunk ingest paths."""
         mem_percent = psutil.virtual_memory().percent
         mem_ok = mem_percent <= 95
         maximum_episodes = (self.args['maximum_episodes'] if mem_ok else
@@ -1899,6 +2028,82 @@ class Learner:
             self.flags.add('memory_over')
         while len(self.trainer.episodes) > maximum_episodes:
             self.trainer.episodes.popleft()
+
+    def feed_chunks(self, chunks: List[Optional[dict]],
+                    recovered: bool = False,
+                    marks: Optional[list] = None) -> list:
+        """Streamed-ingest twin of :meth:`feed_episodes` (streaming.py).
+
+        Each (ledger-screened) chunk is WAL'd, folded into its assembly,
+        and — the moment its contiguous prefix grows — training-visible as
+        a partial buffer entry. A completed assembly closes its ledger
+        task and runs the exact whole-episode accounting feed_episodes
+        runs, on the byte-identical reassembled record. Returns the
+        ``(key, final_args)`` pairs of the episodes completed here (spool
+        recovery uses them to cancel restored book entries)."""
+        from .streaming import chunk_key
+        completed = []
+        for j, chunk in enumerate(chunks):
+            if chunk is None:
+                continue
+            mark = marks[j] if marks is not None \
+                else self.num_returned_episodes
+            if self._spool is not None and not recovered:
+                # WAL before ANY accounting (same stance as feed_episodes):
+                # recovery replays the chunk, the assembler dedupes it
+                self._spool.append(
+                    self.num_returned_episodes,
+                    conn_pack({'idx': self.num_returned_episodes,
+                               'chunk': chunk}))
+            res = self._assembler.add(chunk, mark=mark)
+            status = res.get('status')
+            if status == 'dropped':
+                continue
+            entry = res.get('entry')
+            if res.get('new') and entry is not None:
+                entry.setdefault('recv_time', time.time())
+                self.trainer.episodes.append(entry)
+            if status != 'complete':
+                continue
+            key = chunk_key(chunk)
+            final_args = res.get('final_args') or {}
+            completed.append((key, final_args))
+            if self.ledger is not None:
+                self.ledger.complete_chunked(key, final_args.get('task_id'))
+            record = res.get('record')
+            if record is None:
+                # a poisoned chunk froze the assembly: the task closed, the
+                # record drops whole (mirrors the feed_episodes screen)
+                self._bad_episodes += 1
+                telemetry.counter('guard_bad_episodes_total').inc()
+                _LOG.warning('guard: dropped streamed episode with '
+                             'non-finite data (%d total)', self._bad_episodes)
+                continue
+            if record.get('record_version'):
+                telemetry.counter(
+                    'device_actor_stamped_episodes_total').inc()
+            for p in record['args']['player']:
+                model_id = (record['args'].get('model_id') or {}).get(p, -1)
+                if model_id is None or model_id < 0:
+                    model_id = self.model_epoch
+                outcome = record['outcome'][p]
+                n, r, r2 = self.generation_results.get(model_id, (0, 0, 0))
+                self.generation_results[model_id] = (n + 1, r + outcome,
+                                                     r2 + outcome ** 2)
+            if not recovered:
+                self._league_observe_episode(record)
+            self.num_returned_episodes += 1
+            telemetry.counter('learner_episodes_returned_total').inc()
+            if self.num_returned_episodes % 100 == 0:
+                _LOG.debug('returned %d episodes',
+                           self.num_returned_episodes)
+            if self.trainer.ingest_queue is not None and entry is not None:
+                try:
+                    self.trainer.ingest_queue.put_nowait(entry)
+                except queue.Full:
+                    self.trainer.replay_stats['dropped_episodes'] += 1
+        self._evict_episode_overflow()
+        return completed
 
     def feed_device_chunk(self, done, outcome,
                           model_id: Optional[int] = None) -> int:
@@ -2862,6 +3067,12 @@ class Learner:
             # re-issue with their original sample_keys ahead of fresh work
             ledger.restore_state(self._restored_ledger)
             self._restored_ledger = None
+        if self._recovered_closed_chunks:
+            # streamed assemblies spool recovery reassembled and counted:
+            # close their keys so a reattached gather's resend replays
+            # screen as duplicates instead of re-building the episode
+            ledger.seed_closed_chunks(self._recovered_closed_chunks)
+            self._recovered_closed_chunks = []
         if self._ledger_journal is not None:
             ledger.journal = self._ledger_journal
         if self._durable_restored:
@@ -3063,6 +3274,14 @@ class Learner:
                 ledger.flush_journal()
                 send_data = [None] * len(data)
 
+            elif req == 'chunk':
+                # streamed in-flight windows (streaming.py): screened per
+                # (assembly, chunk index), WAL'd, merged — same flush-after-
+                # spool ordering as whole episodes, extended to partials
+                self.feed_chunks(ledger.admit_chunks(data))
+                ledger.flush_journal()
+                send_data = [None] * len(data)
+
             elif req == 'result':
                 self.feed_results(ledger.admit(data))
                 ledger.flush_journal()
@@ -3123,6 +3342,11 @@ class Learner:
             self.worker.send(conn, send_data)
 
             if cadence.due(self.num_returned_episodes):
+                # abandon streamed assemblies no attempt can ever finish
+                # (e.g. a dead device-actor stream, whose re-issue keys a
+                # new task_id) so they stop pinning the spool GC horizon
+                for key in self._assembler.reap(2 * ledger.deadline):
+                    ledger.abandon_chunks(key)
                 self.update()
                 self._print_fleet_stats()
                 if self._past_epoch_budget():

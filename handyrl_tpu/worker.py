@@ -108,6 +108,17 @@ class Worker:
         # role -> (episode producer, upload RPC name)
         self.playbook = {'g': (generator.execute, 'episode'),
                          'e': (evaluator.execute, 'result')}
+        if (args.get('streaming') or {}).get('enabled'):
+            # streaming ingest: the generator flushes fixed-T chunks
+            # through the same RPC pipe mid-episode ('chunk' uploads ride
+            # the gather's stash/resend machinery like any other kind);
+            # the whole-episode upload collapses into a streamed sentinel
+            # the run loop skips — the learner's assembler completes the
+            # task once every window lands
+            self.playbook['g'] = (
+                lambda models, task: generator.execute(
+                    models, task, emit=lambda c: self._rpc(('chunk', c))),
+                'episode')
 
     def __del__(self):
         _LOG.info('closed worker %d', self.worker_id)
@@ -188,6 +199,10 @@ class Worker:
             telemetry.REGISTRY.histogram(
                 'worker_task_seconds', role=task['role']).observe(
                     time.perf_counter() - t0)
+            if isinstance(payload, dict) and payload.get('streamed'):
+                # every window (final chunk included) already rode the
+                # pipe mid-episode; there is no whole-episode upload
+                continue
             try:
                 self._rpc((upload_as, payload))
             except _CONN_ERRORS:
@@ -281,7 +296,9 @@ class Gather:
             'episode': telemetry.counter('gather_uploads_total',
                                          gather=gid, kind='episode'),
             'result': telemetry.counter('gather_uploads_total',
-                                        gather=gid, kind='result')}
+                                        gather=gid, kind='result'),
+            'chunk': telemetry.counter('gather_uploads_total',
+                                       gather=gid, kind='chunk')}
         self._m_retries = telemetry.counter('gather_rpc_retries_total',
                                             gather=gid)
         self._m_reconnects = telemetry.counter('gather_reconnects_total',
@@ -585,7 +602,10 @@ class Gather:
         """End of the relay's life (training over): ship the final partial
         upload block — it would otherwise die in the box — and beacon a
         last telemetry snapshot so the learner's fleet view includes
-        this relay's complete engine/upload counters."""
+        this relay's complete engine/upload counters. The loop covers
+        every stashed kind, streamed ``'chunk'`` windows included, so a
+        budgeted run's tail chunks land instead of stranding mid-episode
+        assemblies server-side."""
         for kind in list(self._upload_box):
             if self._upload_box[kind]:
                 self._server_rpc((kind, self._upload_box[kind]))
@@ -656,6 +676,12 @@ class DeviceActorGather(Gather):
             slots=slots,
             record_mode=str(gen.get('device_actor_record', '') or ''),
             seed=int(args.get('seed', 0)) * 1009 + gather_id)
+        if (args.get('streaming') or {}).get('enabled'):
+            # streamed windows ride the same upload box as whole episodes
+            # (resend buffer, reconnect replay and the clean-exit flush
+            # all cover the 'chunk' kind)
+            self.device_engine.emit = \
+                lambda c: self._stash_upload('chunk', c)
         self._fallback_gen = Generator(self.host_env, args,
                                        namespace=gather_id)
         self._fallback_eval = Evaluator(self.host_env, args)

@@ -69,8 +69,10 @@ BOUNDED_FIELDS: Dict[str, Dict[str, float]] = {
     'bench-serve': {'tracing_overhead_pct': 2.0},
     'bench-serve-device': {'tracing_overhead_pct': 2.0},
     'bench-gateway': {'tracing_overhead_pct': 2.0},
-    # durable plane: the episode-WAL A/B pair on the host ingest path
-    'bench-ingest': {'spool_overhead_pct': 2.0},
+    # durable plane: the episode-WAL A/B pair on the host ingest path;
+    # streaming plane: the chunked-ingest A/B pair (reassembly cost)
+    'bench-ingest': {'spool_overhead_pct': 2.0,
+                     'chunk_overhead_pct': 2.0},
 }
 
 Key = Tuple[str, str, str]

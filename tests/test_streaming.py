@@ -1,0 +1,421 @@
+"""Streaming partial-episode ingest (streaming.py): chunk reassembly is
+byte-identical to whole-episode ingest under fuzzed window sizes and
+arrival orders, re-issued attempts merge without double-counting, the
+ledger journal + episode spool round-trip the chunk book across a SIGKILL,
+and the staleness-aware sampler's off path is RNG-sequence-identical to
+the pre-streaming sampler."""
+
+import random
+
+import numpy as np
+import pytest
+
+from handyrl_tpu.connection import pack as conn_pack
+from handyrl_tpu.connection import unpack as conn_unpack
+from handyrl_tpu.environment import make_env
+from handyrl_tpu.fault import LedgerJournal, TaskLedger
+from handyrl_tpu.generation import Generator, build_chunk
+from handyrl_tpu.model import ModelWrapper
+from handyrl_tpu.ops.batch import decompress_moments, select_episode
+from handyrl_tpu.spool import EpisodeSpool
+from handyrl_tpu.streaming import ChunkAssembler, chunk_key
+
+
+def _args(chunk_steps=4, compress_steps=4, **stream):
+    s = {'enabled': True, 'chunk_steps': chunk_steps}
+    s.update(stream)
+    return {'observation': False, 'gamma': 0.8,
+            'compress_steps': compress_steps, 'seed': 11, 'streaming': s}
+
+
+@pytest.fixture(scope='module')
+def wrapper():
+    env = make_env({'env': 'TicTacToe'})
+    env.reset()
+    w = ModelWrapper(env.net())
+    w.ensure_params(env.observation(0))
+    return w
+
+
+def _gen_args(sample_key, task_id=7):
+    return {'role': 'g', 'player': [0, 1], 'model_id': {0: 1, 1: 1},
+            'sample_key': sample_key, 'task_id': task_id}
+
+
+def _generate(wrapper, args, sample_key, task_id=7, stream=True):
+    """One TicTacToe episode under the purity contract; returns the whole
+    record (stream=False) or the emitted chunk list (stream=True)."""
+    env = make_env({'env': 'TicTacToe'})
+    gen = Generator(env, args)
+    if not stream:
+        rec = gen.generate({0: wrapper, 1: wrapper},
+                           _gen_args(sample_key, task_id))
+        assert rec is not None and not rec.get('streamed')
+        return rec
+    chunks = []
+    summary = gen.generate({0: wrapper, 1: wrapper},
+                           _gen_args(sample_key, task_id),
+                           emit=chunks.append)
+    assert summary is not None and summary.get('streamed')
+    assert summary['steps'] == sum(c['steps'] for c in chunks)
+    return chunks
+
+
+def _assemble(args, chunks, check_finite=True):
+    asm = ChunkAssembler(args, check_finite=check_finite)
+    result = None
+    for c in chunks:
+        res = asm.add(c)
+        if res['status'] == 'complete':
+            result = res
+    return asm, result
+
+
+def _canonical_moment_bytes(rec):
+    """The training-visible bytes of a record's trajectory: one pickle of
+    the decoded moment stream. pickle re-encoding is a fixed point after
+    one decode (memo layout settles), so streamed reassembly and
+    whole-episode ingest agree on these bytes exactly — the raw bz2 block
+    bytes may differ only in pickle memo layout (numpy dtype sharing in
+    the worker's fresh objects), never in content."""
+    import pickle
+    return pickle.dumps(decompress_moments(rec['moment']))
+
+
+def _assert_records_byte_identical(a, b):
+    assert a['steps'] == b['steps']
+    assert a['outcome'] == b['outcome']
+    assert a['args'] == b['args']
+    assert len(a['moment']) == len(b['moment'])   # same block grid
+    assert _canonical_moment_bytes(a) == _canonical_moment_bytes(b)
+
+
+# ---------------------------------------------------------------------------
+# reassembly byte-identity
+
+
+def test_streamed_chunks_reassemble_byte_identically(wrapper):
+    args = _args(chunk_steps=4, compress_steps=4)
+    whole = _generate(wrapper, args, sample_key=100, stream=False)
+    chunks = _generate(wrapper, args, sample_key=100)
+    assert chunks[-1]['final'] and chunks[-1]['outcome'] is not None
+    # non-final chunks carry no outcome and unfilled returns
+    for c in chunks[:-1]:
+        assert not c['final'] and c['outcome'] is None
+        for m in decompress_moments(c['moment']):
+            assert all(v is None for v in m['return'].values())
+    _, res = _assemble(args, chunks)
+    assert res is not None and res['record'] is not None
+    _assert_records_byte_identical(res['record'], whole)
+    # the buffer entry was swapped into the finished record in place
+    assert res['entry']['moment'] == res['record']['moment']
+    assert 'partial' not in res['entry']
+
+
+def test_fuzz_window_sizes_and_arrival_orders(wrapper):
+    rng = random.Random(17)
+    for trial in range(12):
+        cs = rng.choice([1, 2, 3])
+        T = cs * rng.randint(1, 4)
+        args = _args(chunk_steps=T, compress_steps=cs)
+        skey = 1000 + trial
+        whole = _generate(wrapper, args, sample_key=skey, stream=False)
+        chunks = _generate(wrapper, args, sample_key=skey)
+        shuffled = list(chunks)
+        rng.shuffle(shuffled)
+        asm, res = _assemble(args, shuffled)
+        assert res is not None, 'assembly never completed (trial %d)' % trial
+        _assert_records_byte_identical(res['record'], whole)
+        assert asm.open_count() == 0
+
+
+def test_reissued_attempt_merges_without_double_count(wrapper):
+    """Purity: a re-issued attempt regenerates byte-identical chunks under
+    the same sample_key; the ledger screen admits only the missing ones and
+    the assembly completes exactly once."""
+    args = _args(chunk_steps=2, compress_steps=2)
+    first = _generate(wrapper, args, sample_key=555, task_id=1)
+    again = _generate(wrapper, args, sample_key=555, task_id=2)
+    assert len(first) == len(again) >= 2
+    for a, b in zip(first, again):
+        assert a['moment'] == b['moment']   # the byte-identity the screen rests on
+
+    ledger = TaskLedger(deadline=60)
+    asm = ChunkAssembler(args)
+    # the first attempt dies after delivering only its first chunk
+    admitted = ledger.admit_chunks([first[0]])
+    assert len(admitted) == 1
+    for c in admitted:
+        asm.add(c)
+    # the re-issued attempt replays the WHOLE episode
+    admitted = ledger.admit_chunks(again)
+    assert len(admitted) == len(again) - 1   # chunk 0 screens as duplicate
+    completions = [asm.add(c) for c in admitted]
+    done = [r for r in completions if r['status'] == 'complete']
+    assert len(done) == 1 and done[0]['record'] is not None
+    key = chunk_key(first[0])
+    ledger.complete_chunked(key, done[0]['final_args'].get('task_id'))
+    # post-completion stragglers (resend-buffer replays) all screen out
+    assert ledger.admit_chunks(first) == []
+    assert ledger.stats['duplicates'] >= len(first) + 1
+
+
+# ---------------------------------------------------------------------------
+# partial exposure / staleness bookkeeping
+
+
+def test_partial_entry_grows_in_place_then_finalizes(wrapper):
+    args = _args(chunk_steps=2, compress_steps=2)
+    chunks = _generate(wrapper, args, sample_key=42)
+    assert len(chunks) >= 3
+    asm = ChunkAssembler(args)
+    res0 = asm.add(chunks[0], mark=10)
+    assert res0['status'] == 'open' and res0['new']
+    entry = res0['entry']
+    assert entry['partial'] and entry['steps'] == chunks[0]['steps']
+    assert set(entry['outcome'].values()) == {0.0}   # provisional
+    assert len(entry['chunk_recv']) == 1
+    assert asm.min_open_mark() == 10
+
+    res1 = asm.add(chunks[1], mark=11)
+    assert res1['status'] in ('open', 'complete')
+    assert res1['entry'] is entry and not res1['new']
+    assert entry['steps'] == chunks[0]['steps'] + chunks[1]['steps']
+    assert len(entry['chunk_recv']) == 2
+    assert asm.min_open_mark() == 10     # min over the assembly's marks
+
+    for c in chunks[2:]:
+        res = asm.add(c)
+    assert res['status'] == 'complete' and res['entry'] is entry
+    assert 'partial' not in entry
+    assert entry['outcome'] == res['record']['outcome']
+    assert asm.min_open_mark() is None
+
+
+def test_out_of_order_arrival_defers_exposure(wrapper):
+    args = _args(chunk_steps=2, compress_steps=2)
+    chunks = _generate(wrapper, args, sample_key=43)
+    assert len(chunks) >= 2
+    asm = ChunkAssembler(args)
+    res = asm.add(chunks[-1])          # final first: no contiguous prefix
+    assert res['status'] == 'open' and res['entry'] is None
+    for c in chunks[:-1]:
+        res = asm.add(c)
+    assert res['status'] == 'complete' and res['record'] is not None
+
+
+def test_poisoned_chunk_freezes_assembly_but_completes_task(wrapper):
+    args = _args(chunk_steps=2, compress_steps=2)
+    chunks = _generate(wrapper, args, sample_key=44)
+    assert len(chunks) >= 2
+    # poison chunk 0: NaN observation re-compressed on the same block grid
+    window = decompress_moments(chunks[0]['moment'])
+    window[0]['observation'][window[0]['turn'][0]] = np.full(3, np.nan)
+    for m in window:
+        for p in m['return']:
+            m['return'][p] = None
+    poisoned = build_chunk(chunks[0]['args'], 0, 0, window, args)
+    asm = ChunkAssembler(args, check_finite=True)
+    results = [asm.add(c) for c in [poisoned] + chunks[1:]]
+    done = [r for r in results if r['status'] == 'complete']
+    # the assembly closes (so the task completes) but the record drops whole
+    assert len(done) == 1 and done[0]['record'] is None
+    assert asm.open_count() == 0
+
+
+def test_reap_abandons_stale_assemblies(wrapper):
+    clock = [0.0]
+    args = _args(chunk_steps=2, compress_steps=2)
+    chunks = _generate(wrapper, args, sample_key=45)
+    asm = ChunkAssembler(args, clock=lambda: clock[0])
+    asm.add(chunks[0], mark=3)
+    assert asm.open_count() == 1 and asm.min_open_mark() == 3
+    clock[0] = 10.0
+    assert asm.reap(older_than=100) == []
+    clock[0] = 1000.0
+    reaped = asm.reap(older_than=100)
+    assert reaped == [chunk_key(chunks[0])]
+    assert asm.open_count() == 0 and asm.min_open_mark() is None
+
+
+# ---------------------------------------------------------------------------
+# ledger journal + spool: the SIGKILL story
+
+
+def test_journal_round_trips_chunk_book(tmp_path, wrapper):
+    args = _args(chunk_steps=2, compress_steps=2)
+    chunks = _generate(wrapper, args, sample_key=777, task_id=0)
+    key = chunk_key(chunks[0])
+
+    ledger = TaskLedger(deadline=60)
+    ledger.journal = LedgerJournal(str(tmp_path))
+    role = _gen_args(777)
+    del role['task_id']
+    tid = ledger.assign('w1', role)
+    admitted = ledger.admit_chunks(chunks[:1])
+    assert len(admitted) == 1
+    ledger.flush_journal()   # the server flushes after the spool append
+
+    state = LedgerJournal(str(tmp_path)).load()
+    assert state['chunks'] == [[list(key), [0]]]
+    restored = TaskLedger(deadline=60)
+    restored.restore_state(state)
+    # the restored screen drops the already-delivered chunk, admits the rest
+    admitted = restored.admit_chunks(chunks)
+    assert [c['chunk'] for c in admitted] == \
+        [c['chunk'] for c in chunks[1:]]
+
+    # closing the assembly journals 'q': the delta-only closure surfaces as
+    # chunks_closed so spool recovery knows to replay those chunks
+    ledger.complete_chunked(key, tid)
+    ledger.flush_journal()
+    state = LedgerJournal(str(tmp_path)).load()
+    assert 'chunks' not in state
+    assert state['chunks_closed'] == [list(key)]
+    # a post-snapshot load folds the closure away entirely
+    ledger.journal.snapshot(ledger.snapshot_state())
+    state = LedgerJournal(str(tmp_path)).load()
+    assert 'chunks' not in state and 'chunks_closed' not in state
+
+
+def test_sigkill_mid_episode_replays_chunks_without_double_count(
+        tmp_path, wrapper):
+    """The learner dies after WAL'ing a strict prefix of an episode's
+    chunks. The restarted learner replays them from the spool (screened by
+    the journaled book), the re-issued attempt delivers the rest, and the
+    episode completes exactly once, byte-identical to whole-episode ingest."""
+    args = _args(chunk_steps=2, compress_steps=2)
+    whole = _generate(wrapper, args, sample_key=888, task_id=0, stream=False)
+    chunks = _generate(wrapper, args, sample_key=888, task_id=0)
+    assert len(chunks) >= 2
+    key = chunk_key(chunks[0])
+
+    # --- first life: spool append THEN journal flush, per chunk
+    spool = EpisodeSpool(str(tmp_path), segment_mb=1)
+    ledger = TaskLedger(deadline=60)
+    ledger.journal = LedgerJournal(str(tmp_path))
+    role = _gen_args(888)
+    del role['task_id']
+    ledger.assign('w1', role)
+    delivered = ledger.admit_chunks(chunks[:-1])
+    for i, c in enumerate(delivered):
+        spool.append(i, conn_pack({'idx': i, 'chunk': c}))
+        ledger.flush_journal()
+    ledger.journal.close()
+    spool.close()
+    # SIGKILL here: nothing below reuses first-life in-memory state
+
+    # --- second life: journal -> book; spool -> chunk replay
+    state = LedgerJournal(str(tmp_path)).load()
+    ledger2 = TaskLedger(deadline=60)
+    ledger2.restore_state(state)
+    live_keys = {tuple(k) for k, _ in
+                 (pair for pair in state.get('chunks') or ())}
+    assert key in live_keys
+    recovered = EpisodeSpool(str(tmp_path), segment_mb=1).recover(
+        0, conn_unpack)
+    replay = [rec['chunk'] for rec in recovered
+              if rec.get('chunk') is not None
+              and chunk_key(rec['chunk']) in live_keys]
+    assert len(replay) == len(chunks) - 1
+    asm = ChunkAssembler(args)
+    for rec, c in zip(recovered, replay):
+        asm.add(c, mark=rec['idx'])
+        # replayed chunks were already journaled: re-seed, no new delta op
+        ledger2.seed_chunk(chunk_key(c), c['chunk'])
+    assert asm.open_count() == 1
+
+    # the re-issued attempt regenerates the episode; only the tail admits
+    admitted = ledger2.admit_chunks(chunks)
+    assert [c['chunk'] for c in admitted] == [chunks[-1]['chunk']]
+    results = [asm.add(c) for c in admitted]
+    done = [r for r in results if r['status'] == 'complete']
+    assert len(done) == 1
+    _assert_records_byte_identical(done[0]['record'], whole)
+    # recovery-completed assemblies seed the closed ring: a reattached
+    # gather's resend replay of the SAME episode screens as duplicates
+    ledger2.complete_chunked(key, done[0]['final_args'].get('task_id'))
+    assert ledger2.admit_chunks(chunks) == []
+
+
+# ---------------------------------------------------------------------------
+# staleness-aware selection
+
+
+def _buffer_args(**stream):
+    a = {'maximum_episodes': 64, 'forward_steps': 2, 'burn_in_steps': 0,
+         'compress_steps': 2}
+    if stream:
+        a['streaming'] = stream
+    return a
+
+
+def _fake_episodes(wrapper, n=6):
+    args = _args(chunk_steps=2, compress_steps=2)
+    eps = []
+    for i in range(n):
+        rec = _generate(wrapper, args, sample_key=2000 + i, stream=False)
+        rec['recv_time'] = 100.0 + i
+        eps.append(rec)
+    return eps
+
+
+def test_staleness_off_path_is_rng_sequence_identical(wrapper):
+    """streaming.staleness_half_life == 0 must add ZERO random draws: the
+    selection sequence is byte-identical to a config with no streaming
+    block at all (the GL001 off-is-identical contract)."""
+    eps = _fake_episodes(wrapper)
+    baseline_args = _buffer_args()
+    stream_args = _buffer_args(enabled=True, staleness_half_life=0.0,
+                               max_reselect=4)
+    random.seed(99)
+    baseline = [select_episode(eps, baseline_args) for _ in range(40)]
+    base_state = random.getstate()
+    random.seed(99)
+    streamed = [select_episode(eps, stream_args) for _ in range(40)]
+    assert random.getstate() == base_state
+    for a, b in zip(baseline, streamed):
+        assert (a['train_start'], a['start'], a['end'], a['total']) == \
+            (b['train_start'], b['start'], b['end'], b['total'])
+        assert a['moment'] == b['moment']
+
+
+def test_staleness_weighting_prefers_fresh_chunks(wrapper):
+    eps = _fake_episodes(wrapper, n=2)
+    now = 1000.0
+    # episode 0: a streamed entry whose only exposed chunk is ancient
+    eps[0]['chunk_recv'] = [now - 1e7]
+    eps[0]['chunk_steps'] = 2
+    # episode 1: fresh whole-episode entry
+    eps[1]['recv_time'] = now
+    args = _buffer_args(enabled=True, staleness_half_life=1.0,
+                        max_reselect=4)
+    random.seed(5)
+    picks = [select_episode(eps, args, now=now) for _ in range(200)]
+    stale = sum(1 for s in picks if s['recv_time'] == eps[0]['chunk_recv'][0])
+    fresh = sum(1 for s in picks if s['recv_time'] == now)
+    assert stale + fresh == len(picks)
+    # the accept probability for the stale chunk is ~2^-1e7: it is only
+    # ever taken when all max_reselect re-draws land on it
+    assert fresh > stale
+    # per-chunk sample_age plumbing: streamed picks report the CHUNK's
+    # ingest stamp, not the episode-level one
+    assert all(s['recv_time'] == eps[0]['chunk_recv'][0]
+               for s in picks if s['total'] == eps[0]['steps']
+               and s['recv_time'] != now)
+
+
+# ---------------------------------------------------------------------------
+# config contract
+
+
+def test_config_rejects_misaligned_chunk_steps():
+    from handyrl_tpu.config import apply_defaults
+    apply_defaults({})   # defaults (streaming off) are self-consistent
+    apply_defaults({'train_args': {
+        'compress_steps': 4,
+        'streaming': {'enabled': True, 'chunk_steps': 8}}})
+    with pytest.raises(AssertionError):
+        apply_defaults({'train_args': {
+            'compress_steps': 4,
+            'streaming': {'enabled': True, 'chunk_steps': 6}}})
